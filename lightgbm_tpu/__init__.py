@@ -1,0 +1,35 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of LightGBM
+(tlikhomanenko/LightGBM line, incl. InfiniteBoost): histograms, split search
+and partitioning run as fused XLA programs on TPU; data/feature-parallel
+training lowers the reference's socket Allreduce to `jax.lax.psum` over an
+ICI mesh; the Python API (`Dataset`, `Booster`, `train`, `cv`, sklearn
+wrappers) and the text model format interchange with the reference
+(python-package/lightgbm/__init__.py:26-30).
+"""
+from .basic import Booster, Dataset
+from .engine import cv, train
+from .utils.log import LightGBMError
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+
+try:
+    from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+    _SKLEARN_EXPORTS = ["LGBMModel", "LGBMRegressor", "LGBMClassifier",
+                        "LGBMRanker"]
+except ImportError:
+    _SKLEARN_EXPORTS = []
+
+try:
+    from .plotting import plot_importance, plot_metric, plot_tree, create_tree_digraph
+    _PLOT_EXPORTS = ["plot_importance", "plot_metric", "plot_tree",
+                     "create_tree_digraph"]
+except ImportError:
+    _PLOT_EXPORTS = []
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "train", "cv", "LightGBMError",
+           "EarlyStopException", "early_stopping", "print_evaluation",
+           "record_evaluation", "reset_parameter"] + _SKLEARN_EXPORTS + _PLOT_EXPORTS

@@ -1,0 +1,475 @@
+"""Python-facing Dataset / Booster — API parity with python-package/basic.py.
+
+The reference wraps the C library through ctypes (basic.py:21,546,1171); here
+the same public surface drives the in-process TPU engine directly, so there
+is no language boundary to cross.  Semantics kept: lazy Dataset
+construction, reference-alignment of validation sets, parameter dict
+handling, custom objective ``fobj(preds, train_data) -> (grad, hess)`` via
+``Booster.update``, prediction modes (raw/prob/leaf-index), model file
+round-trip, continued training via ``init_model``.
+"""
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .io.dataset import TrainingData
+from .metrics import create_metric
+from .models.gbdt import GBDT
+from .models.factory import create_boosting
+from .objectives import create_objective
+from .utils.config import Config, param_dict_to_str
+from .utils.log import LightGBMError, Log
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+def _data_from_any(data, label=None):
+    """Accept numpy 2-D, pandas DataFrame, list-of-lists, or file path."""
+    if isinstance(data, str):
+        return data, label
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return data.values.astype(np.float64), label
+        if label is not None and isinstance(label, (pd.Series, pd.DataFrame)):
+            label = label.values
+    except ImportError:
+        pass
+    return np.asarray(data, dtype=np.float64), label
+
+
+class Dataset:
+    """Lazily-constructed training dataset (python-package basic.py:546)."""
+
+    def __init__(self, data, label=None, max_bin=None, reference=None,
+                 weight=None, group=None, init_score=None, silent=False,
+                 feature_name="auto", categorical_feature="auto", params=None,
+                 free_raw_data=True):
+        data, label = _data_from_any(data, label)
+        self.data = data
+        self.label = label
+        self.max_bin = max_bin
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.silent = silent
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        if max_bin is not None:
+            self.params.setdefault("max_bin", max_bin)
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[TrainingData] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ------------------------------------------------------------ construct
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        params = dict(self.params)
+        cfg = Config(params)
+        cat = []
+        feature_names = None
+        if isinstance(self.data, str):
+            ref_td = self.reference._handle if self.reference is not None else None
+            if TrainingData.can_load_binary(self.data):
+                self._handle = TrainingData.load_binary(self.data)
+            else:
+                self._handle = TrainingData.from_file(self.data, cfg,
+                                                      reference=ref_td)
+        else:
+            data = np.asarray(self.data, dtype=np.float64)
+            if self.categorical_feature not in (None, "auto"):
+                cat = [int(c) for c in self.categorical_feature]
+            if self.feature_name not in (None, "auto"):
+                feature_names = list(self.feature_name)
+            ref_td = None
+            if self.reference is not None:
+                self.reference.construct()
+                ref_td = self.reference._handle
+            self._handle = TrainingData.from_matrix(
+                data, label=self.label, config=cfg,
+                weights=self.weight, group=self.group,
+                init_score=self.init_score,
+                categorical_feature=cat, feature_names=feature_names,
+                reference=ref_td, keep_raw=True)
+        if self.label is not None and self._handle.metadata.label is None:
+            self._handle.metadata.set_label(self.label)
+        if not self.free_raw_data and isinstance(self.data, np.ndarray):
+            self._handle.raw_data = self.data
+        # continued-training predictor fills init scores
+        # (engine.py:92-98 / dataset predict_fun_ path)
+        if self._predictor is not None and self._handle.raw_data is not None:
+            raw = self._predictor.predict_raw_for_init(self._handle.raw_data)
+            self._handle.metadata.set_init_score(raw.T.reshape(-1))
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, silent=False, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self,
+                       weight=weight, group=group, init_score=init_score,
+                       silent=silent, params=params or self.params,
+                       free_raw_data=self.free_raw_data)
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        self.reference = reference
+        return self
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        self.construct()
+        used_indices = np.asarray(used_indices)
+        if self._handle.raw_data is None:
+            Log.fatal("Cannot subset a Dataset whose raw data was freed")
+        sub = Dataset(self._handle.raw_data[used_indices],
+                      label=None if self.label is None else np.asarray(self.label)[used_indices],
+                      reference=self,
+                      weight=None if self.weight is None else np.asarray(self.weight)[used_indices],
+                      params=params or self.params,
+                      free_raw_data=self.free_raw_data)
+        sub.used_indices = used_indices
+        return sub
+
+    # ------------------------------------------------------------- metadata
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._handle is not None:
+            self._handle.metadata.set_label(label)
+        return self
+
+    def get_label(self):
+        if self._handle is not None and self._handle.metadata.label is not None:
+            return np.asarray(self._handle.metadata.label)
+        return self.label
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._handle is not None:
+            self._handle.metadata.set_weights(weight)
+        return self
+
+    def get_weight(self):
+        if self._handle is not None and self._handle.metadata.weights is not None:
+            return np.asarray(self._handle.metadata.weights)
+        return self.weight
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._handle is not None:
+            self._handle.metadata.set_query_counts(group)
+        return self
+
+    def get_group(self):
+        if self._handle is not None and self._handle.metadata.query_boundaries is not None:
+            return np.diff(self._handle.metadata.query_boundaries)
+        return self.group
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._handle is not None:
+            self._handle.metadata.set_init_score(init_score)
+        return self
+
+    def get_init_score(self):
+        if self._handle is not None:
+            return self._handle.metadata.init_score
+        return self.init_score
+
+    def set_field(self, field_name: str, data) -> None:
+        self.construct()
+        self._handle.metadata.set_field(field_name, data)
+
+    def get_field(self, field_name: str):
+        self.construct()
+        return self._handle.metadata.get_field(field_name)
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._handle is not None and categorical_feature != self.categorical_feature:
+            Log.warning("categorical_feature in Dataset is overridden; "
+                        "new categorical_feature is %s", str(categorical_feature))
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        self.feature_name = feature_name
+        if feature_name not in (None, "auto") and self._handle is not None:
+            self._handle.feature_names = list(feature_name)
+        return self
+
+    def _update_params(self, params: Optional[dict]) -> "Dataset":
+        if params:
+            self.params.update(params)
+        return self
+
+    def _set_predictor(self, predictor) -> "Dataset":
+        self._predictor = predictor
+        return self
+
+    # ------------------------------------------------------------------ info
+    def num_data(self) -> int:
+        if self._handle is not None:
+            return self._handle.num_data
+        if isinstance(self.data, np.ndarray):
+            return self.data.shape[0]
+        Log.fatal("Cannot get num_data before construct")
+
+    def num_feature(self) -> int:
+        if self._handle is not None:
+            return self._handle.num_total_features
+        if isinstance(self.data, np.ndarray):
+            return self.data.shape[1]
+        Log.fatal("Cannot get num_feature before construct")
+
+    def save_binary(self, filename: str) -> None:
+        self.construct()
+        self._handle.save_binary(filename)
+
+
+class _InnerPredictor:
+    """Continued-training score provider (basic.py:293-543 analog)."""
+
+    def __init__(self, booster: Optional["Booster"] = None,
+                 model_file: Optional[str] = None):
+        if booster is not None:
+            self.gbdt = booster._gbdt
+        elif model_file is not None:
+            cfg = Config()
+            self.gbdt = GBDT(cfg)
+            with open(model_file) as f:
+                self.gbdt.load_model_from_string(f.read())
+        else:
+            raise LightGBMError("Need booster or model_file")
+
+    def predict_raw_for_init(self, features: np.ndarray) -> np.ndarray:
+        return self.gbdt.predict_raw(features)
+
+
+class Booster:
+    """Training-capable model wrapper (python-package basic.py:1171)."""
+
+    def __init__(self, params: Optional[dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        self._valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self._network = False
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance, met %s"
+                                % type(train_set).__name__)
+            cfg = Config(self.params)
+            train_set._update_params(self.params).construct()
+            objective = create_objective(cfg.objective, cfg)
+            if objective is not None:
+                objective.init(train_set._handle.metadata,
+                               train_set._handle.num_data)
+            training_metrics = []
+            if cfg.is_training_metric or self.params.get("is_training_metric"):
+                for mname in cfg.metrics():
+                    m = create_metric(mname, cfg)
+                    if m is not None:
+                        m.init(train_set._handle.metadata,
+                               train_set._handle.num_data)
+                        training_metrics.append(m)
+            self._gbdt = create_boosting(cfg.boosting_type, cfg,
+                                         train_set._handle, objective,
+                                         training_metrics)
+            self._cfg = cfg
+            # continuation: fold loaded models in
+            if train_set._predictor is not None:
+                base = train_set._predictor.gbdt
+                self._gbdt.models = list(base.models) + self._gbdt.models
+                self._gbdt.num_init_iteration = (
+                    len(base.models) // max(base.num_tree_per_iteration, 1))
+                self._gbdt.boost_from_average_used = base.boost_from_average_used
+        elif model_file is not None:
+            with open(model_file) as f:
+                self._load_from_string(f.read())
+        elif model_str is not None:
+            self._load_from_string(model_str)
+        else:
+            raise TypeError("Need at least one training dataset or model "
+                            "file to create booster instance")
+
+    def _load_from_string(self, model_str: str) -> None:
+        self._cfg = Config(self.params)
+        self._gbdt = GBDT(self._cfg)
+        self._gbdt.load_model_from_string(model_str)
+        self._train_set = None
+
+    # ------------------------------------------------------------- training
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be Dataset instance, met %s"
+                            % type(data).__name__)
+        data._update_params(self.params).construct()
+        metrics = []
+        for mname in self._cfg.metrics():
+            m = create_metric(mname, self._cfg)
+            if m is not None:
+                m.init(data._handle.metadata, data._handle.num_data)
+                metrics.append(m)
+        self._gbdt.add_valid_dataset(data._handle, metrics)
+        self._valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; with fobj mirrors the __boost path
+        (basic.py:1331-1412)."""
+        if train_set is not None and train_set is not self._train_set:
+            Log.fatal("Resetting train set inside update is not supported yet")
+        if fobj is None:
+            return self._gbdt.train_one_iter(None, None, False)
+        grad, hess = fobj(self.__inner_predict_raw(0), self._train_set)
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, dtype=np.float32)
+        hess = np.asarray(hess, dtype=np.float32)
+        if len(grad) != len(hess):
+            raise ValueError("Lengths of gradient(%d) and hessian(%d) don't match"
+                             % (len(grad), len(hess)))
+        return self._gbdt.train_one_iter(grad, hess, False)
+
+    def __inner_predict_raw(self, data_idx: int) -> np.ndarray:
+        if data_idx == 0:
+            return self._gbdt._score_for_objective()
+        return self._gbdt.valid_score[data_idx - 1].reshape(-1)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.total_iterations()
+
+    # ----------------------------------------------------------------- eval
+    def eval(self, data: Dataset, name: str, feval=None) -> List[tuple]:
+        if data is self._train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self._valid_sets):
+            if data is vs:
+                return self.__eval(i + 1, self.name_valid_sets[i], feval)
+        raise LightGBMError("Data should be train set or a validation set")
+
+    def eval_train(self, feval=None) -> List[tuple]:
+        return self.__eval(0, "training", feval)
+
+    def eval_valid(self, feval=None) -> List[tuple]:
+        out = []
+        for i, name in enumerate(self.name_valid_sets):
+            out.extend(self.__eval(i + 1, name, feval))
+        return out
+
+    def __eval(self, data_idx: int, name: str, feval=None) -> List[tuple]:
+        out = []
+        scores = self._gbdt.get_eval_at(data_idx)
+        names = self._gbdt.eval_names(data_idx)
+        higher_better = self._eval_higher_better(data_idx)
+        for mname, s, hb in zip(names, scores, higher_better):
+            out.append((name, mname, s, hb))
+        if feval is not None:
+            if data_idx == 0:
+                ds = self._train_set
+            else:
+                ds = self._valid_sets[data_idx - 1]
+            ret = feval(self.__inner_predict_for_eval(data_idx), ds)
+            if isinstance(ret, list):
+                for fname, val, hb in ret:
+                    out.append((name, fname, val, hb))
+            else:
+                fname, val, hb = ret
+                out.append((name, fname, val, hb))
+        return out
+
+    def _eval_higher_better(self, data_idx: int) -> List[bool]:
+        ms = (self._gbdt.training_metrics if data_idx == 0
+              else self._gbdt.valid_metrics[data_idx - 1])
+        out = []
+        for m in ms:
+            out.extend([m.factor_to_bigger_better > 0] * len(m.get_names()))
+        return out
+
+    def __inner_predict_for_eval(self, data_idx: int) -> np.ndarray:
+        raw = (self._gbdt.train_score if data_idx == 0
+               else self._gbdt.valid_score[data_idx - 1])
+        return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
+
+    # -------------------------------------------------------------- predict
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, data_has_header: bool = False,
+                is_reshape: bool = True, pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0):
+        if isinstance(data, Dataset):
+            raise TypeError("Cannot use Dataset instance for prediction, "
+                            "please use raw data instead")
+        if isinstance(data, str):
+            from .io import parser as _parser
+            parsed = _parser.parse_file(data, has_header=data_has_header)
+            mat = parsed.features
+        else:
+            mat, _ = _data_from_any(data)
+            mat = np.asarray(mat, dtype=np.float64)
+            if mat.ndim == 1:
+                mat = mat.reshape(1, -1)
+        return self._gbdt.predict(mat, num_iteration=num_iteration,
+                                  raw_score=raw_score, pred_leaf=pred_leaf)
+
+    # ------------------------------------------------------------ model I/O
+    def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        self._gbdt.save_model_to_file(filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        return self._gbdt.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        import json
+        return json.loads(self._gbdt.dump_model(num_iteration))
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        return self._gbdt.feature_importance()
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    # pickling support: serialize through the text model format
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._train_set = None
+        self._valid_sets = []
+        self.name_valid_sets = []
+        self._load_from_string(state["model_str"])
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        return Booster(params=dict(self.params),
+                       model_str=self.model_to_string())

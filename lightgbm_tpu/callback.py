@@ -1,0 +1,135 @@
+"""Composable training callbacks — API parity with python-package/callback.py.
+
+``CallbackEnv`` carries the same fields; ``early_stopping`` raises
+``EarlyStopException`` exactly like the reference (callback.py:48-204).
+"""
+from __future__ import annotations
+
+import collections
+from operator import gt, lt
+
+from .utils.log import Log
+
+
+class EarlyStopException(Exception):
+    """Raised by callbacks to stop training (callback.py:14-24)."""
+
+    def __init__(self, best_iteration, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "LightGBMCallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _format_eval_result(value, show_stdv: bool = True) -> str:
+    if len(value) == 4:
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    if len(value) == 5:
+        if show_stdv:
+            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    raise ValueError("Wrong metric value")
+
+
+def print_evaluation(period: int = 1, show_stdv: bool = True):
+    def callback(env: CallbackEnv):
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(_format_eval_result(x, show_stdv)
+                               for x in env.evaluation_result_list)
+            Log.info("[%d]\t%s", env.iteration + 1, result)
+    callback.order = 10
+    return callback
+
+
+def record_evaluation(eval_result: dict):
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+    eval_result.clear()
+
+    def init(env: CallbackEnv):
+        for data_name, eval_name, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+
+    def callback(env: CallbackEnv):
+        if not eval_result:
+            init(env)
+        for data_name, eval_name, result, _ in env.evaluation_result_list:
+            eval_result[data_name][eval_name].append(result)
+    callback.order = 20
+    return callback
+
+
+def reset_parameter(**kwargs):
+    """Per-iteration parameter schedule; only learning_rate takes effect on
+    the in-process engine for now (mirrors reset_parameter semantics)."""
+    def callback(env: CallbackEnv):
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError("Length of list %s has to equal to 'num_boost_round'." % key)
+                new_param = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_param = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("Only list and callable values are supported "
+                                 "as a mapping from boosting round index to new parameter value.")
+            new_parameters[key] = new_param
+        if new_parameters:
+            if "learning_rate" in new_parameters:
+                env.model._gbdt.shrinkage_rate = float(new_parameters["learning_rate"])
+            env.params.update(new_parameters)
+    callback.before_iteration = True
+    callback.order = 10
+    return callback
+
+
+def early_stopping(stopping_rounds: int, verbose: bool = True):
+    """Stop when no metric improves for stopping_rounds (callback.py:133-204)."""
+    best_score = []
+    best_iter = []
+    best_score_list = []
+    cmp_op = []
+
+    def init(env: CallbackEnv):
+        if not env.evaluation_result_list:
+            raise ValueError("For early stopping, at least one dataset and "
+                             "eval metric is required for evaluation")
+        if verbose:
+            Log.info("Train until valid scores didn't improve in %d rounds.",
+                     stopping_rounds)
+        for eval_ret in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if eval_ret[3]:
+                best_score.append(float("-inf"))
+                cmp_op.append(gt)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lt)
+
+    def callback(env: CallbackEnv):
+        if not cmp_op:
+            init(env)
+        for i, eval_ret in enumerate(env.evaluation_result_list):
+            score = eval_ret[2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            elif env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
+                             best_iter[i] + 1,
+                             "\t".join(_format_eval_result(x)
+                                       for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    callback.order = 30
+    return callback

@@ -1,0 +1,231 @@
+"""Training entry points: ``train`` and ``cv`` — parity with
+python-package/engine.py:17-315 (callback-driven loop, early stopping via
+exception, init_model continuation, stratified/group folds)."""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, _InnerPredictor
+from .utils.config import key_alias_transform
+from .utils.log import LightGBMError, Log
+
+__all__ = ["train", "cv"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+          valid_sets=None, valid_names=None, fobj=None, feval=None,
+          init_model=None, feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds=None, evals_result=None, verbose_eval=True,
+          learning_rates=None, keep_training_booster=False, callbacks=None):
+    """Mirror of engine.py:17-203."""
+    params = key_alias_transform(dict(params or {}))
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if "early_stopping_round" in params and params["early_stopping_round"] is not None:
+        early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if fobj is not None:
+        params["objective"] = "none"
+
+    predictor = None
+    if init_model is not None:
+        if isinstance(init_model, str):
+            predictor = _InnerPredictor(model_file=init_model)
+        elif isinstance(init_model, Booster):
+            predictor = _InnerPredictor(booster=init_model)
+    init_iteration = (len(predictor.gbdt.models) // max(predictor.gbdt.num_tree_per_iteration, 1)
+                      if predictor is not None else 0)
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    train_set._update_params(params)
+    if predictor is not None:
+        train_set._set_predictor(predictor)
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
+
+    # objective 'none' with fobj: booster builds without internal objective
+    if fobj is not None:
+        params["objective"] = "none"
+    booster = Booster(params=params, train_set=train_set)
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Validation data should be Dataset instance, "
+                                "met %s" % type(valid_data).__name__)
+            valid_data.set_reference(train_set)
+            reduced_valid_sets.append(valid_data)
+            if valid_names is not None and len(valid_names) > i:
+                name_valid_sets.append(valid_names[i])
+            else:
+                name_valid_sets.append("valid_%d" % i)
+    for valid_data, name in zip(reduced_valid_sets, name_valid_sets):
+        booster.add_valid(valid_data, name)
+
+    # callbacks
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    cbs_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda cb: getattr(cb, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda cb: getattr(cb, "order", 0))
+
+    booster.best_iteration = -1
+    finished_iteration = num_boost_round
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                        iteration=i,
+                                        begin_iteration=init_iteration,
+                                        end_iteration=init_iteration + num_boost_round,
+                                        evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets is not None or feval is not None:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                            iteration=i,
+                                            begin_iteration=init_iteration,
+                                            end_iteration=init_iteration + num_boost_round,
+                                            evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as earlyStopException:
+            booster.best_iteration = earlyStopException.best_iteration + 1
+            evaluation_result_list = earlyStopException.best_score
+            finished_iteration = booster.best_iteration
+            break
+    booster.best_score = collections.defaultdict(dict)
+    for dataset_name, eval_name, score, _ in evaluation_result_list or []:
+        booster.best_score[dataset_name][eval_name] = score
+    return booster
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
+                  seed: int, fpreproc=None, stratified: bool = False,
+                  shuffle: bool = True):
+    """engine.py:227-286 fold construction."""
+    full_data = full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and hasattr(folds, "split"):
+            folds = folds.split(X=np.zeros(num_data),
+                                y=full_data.get_label())
+    else:
+        if stratified:
+            try:
+                from sklearn.model_selection import StratifiedKFold
+                skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                                      random_state=seed if shuffle else None)
+                folds = skf.split(np.zeros(num_data), full_data.get_label())
+            except ImportError:
+                raise LightGBMError("Scikit-learn is required for stratified cv")
+        else:
+            rng = np.random.default_rng(seed)
+            randidx = rng.permutation(num_data) if shuffle else np.arange(num_data)
+            kstep = int(num_data / nfold)
+            folds = []
+            for k in range(nfold):
+                test_id = randidx[k * kstep: (k + 1) * kstep if k + 1 < nfold else num_data]
+                train_id = np.setdiff1d(randidx, test_id, assume_unique=True)
+                folds.append((train_id, test_id))
+    ret = []
+    for train_idx, test_idx in folds:
+        train_sub = full_data.subset(np.sort(np.asarray(train_idx)))
+        valid_sub = full_data.subset(np.sort(np.asarray(test_idx)))
+        if fpreproc is not None:
+            train_sub, valid_sub, tparam = fpreproc(train_sub, valid_sub,
+                                                    params.copy())
+        else:
+            tparam = params
+        ret.append((train_sub, valid_sub, tparam))
+    return ret
+
+
+def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
+       stratified: bool = False, shuffle: bool = True, metrics=None, fobj=None,
+       feval=None, init_model=None, feature_name="auto",
+       categorical_feature="auto", early_stopping_rounds=None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks=None):
+    """Mirror of engine.py:288-425; returns dict of per-iteration mean/stdv."""
+    params = key_alias_transform(dict(params or {}))
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if metrics:
+        params["metric"] = metrics
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, folds, nfold, params, seed,
+                            fpreproc=fpreproc, stratified=stratified,
+                            shuffle=shuffle)
+    boosters = []
+    for train_sub, valid_sub, tparam in cvfolds:
+        bst = Booster(params=tparam, train_set=train_sub)
+        bst.add_valid(valid_sub, "valid")
+        boosters.append(bst)
+
+    best_iter = num_boost_round
+    for i in range(num_boost_round):
+        agg = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for (_, name, score, hb) in bst.eval_valid(feval):
+                agg[(name, hb)].append(score)
+        one_result = {}
+        for (name, hb), scores in agg.items():
+            results[name + "-mean"].append(float(np.mean(scores)))
+            results[name + "-stdv"].append(float(np.std(scores)))
+            one_result[name] = (float(np.mean(scores)), hb)
+        if verbose_eval:
+            msg = "\t".join("cv_agg %s: %g + %g" % (n.rsplit("-", 1)[0], m, s)
+                            for (n, m), s in zip(
+                                [(k, v[-1]) for k, v in results.items() if k.endswith("mean")],
+                                [v[-1] for k, v in results.items() if k.endswith("stdv")]))
+            Log.info("[%d]\t%s", i + 1, msg)
+        if early_stopping_rounds is not None and early_stopping_rounds > 0:
+            # stop if the first metric hasn't improved for the window
+            key = next(iter(results))
+            vals = results[key]
+            hb = next(iter(agg))[1] if agg else False
+            best_idx = int(np.argmax(vals) if hb else np.argmin(vals))
+            if i - best_idx >= early_stopping_rounds:
+                best_iter = best_idx + 1
+                for k in list(results.keys()):
+                    results[k] = results[k][:best_idx + 1]
+                break
+    return dict(results)

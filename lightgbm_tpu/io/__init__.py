@@ -1,0 +1,6 @@
+from .binning import BinMapper, greedy_find_bin, NUMERICAL, CATEGORICAL
+from .metadata import Metadata
+from .dataset import TrainingData
+
+__all__ = ["BinMapper", "greedy_find_bin", "NUMERICAL", "CATEGORICAL",
+           "Metadata", "TrainingData"]

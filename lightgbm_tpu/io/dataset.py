@@ -1,0 +1,329 @@
+"""Training dataset: binned column store + metadata (host side).
+
+Parity target: src/io/dataset.cpp + src/io/dataset_loader.cpp.  Differences
+by design (TPU-first): the binned matrix is a dense row-major
+``(num_data, num_used_features)`` uint8/uint16 array destined for device HBM
+(row-sharded under data-parallel training) instead of per-group Bin objects —
+the moral equivalent of the GPU learner's Feature4 packing
+(gpu_tree_learner.cpp:234-353) without the dword gymnastics.  EFB bundling is
+not needed for correctness (a bundle is a perf optimization) and is tracked as
+a later optimization.
+
+Reference flow mirrored here (dataset_loader.cpp:159-216,661-840):
+sample rows -> per-feature BinMapper.find_bin -> drop trivial features ->
+bin all rows -> metadata check.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.config import Config
+from ..utils.log import Log
+from ..utils.random import Random
+from .binning import BinMapper, CATEGORICAL, NUMERICAL
+from .metadata import Metadata
+from . import parser as _parser
+
+
+class TrainingData:
+    """The constructed dataset the tree learner consumes.
+
+    Naming note: the Python-facing ``Dataset`` wrapper lives in basic.py; this
+    class corresponds to the C++ ``Dataset`` (include/LightGBM/dataset.h:280).
+    """
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        # per total-feature BinMapper (None for ignored)
+        self.bin_mappers: List[Optional[BinMapper]] = []
+        # inner (used) feature -> real feature index
+        self.used_feature_idx: List[int] = []
+        # real -> inner (-1 if unused), used_feature_map_ in the reference
+        self.real_to_inner: Dict[int, int] = {}
+        self.binned: Optional[np.ndarray] = None      # (N, F_used)
+        self.metadata: Metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+        # learner-facing per-inner-feature arrays
+        self.num_bin_arr: Optional[np.ndarray] = None
+        self.default_bin_arr: Optional[np.ndarray] = None
+        self.is_categorical_arr: Optional[np.ndarray] = None
+        self.raw_data: Optional[np.ndarray] = None    # kept for valid alignment
+
+    # ------------------------------------------------------------- construct
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, label=None, config: Optional[Config] = None,
+                    weights=None, group=None, init_score=None,
+                    categorical_feature: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["TrainingData"] = None,
+                    keep_raw: bool = False) -> "TrainingData":
+        config = config or Config()
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        if data.ndim != 2:
+            Log.fatal("Data must be 2-dimensional")
+        self = cls()
+        self.num_data, self.num_total_features = data.shape
+        self.max_bin = config.max_bin
+        self.feature_names = list(feature_names) if feature_names else [
+            "Column_%d" % i for i in range(self.num_total_features)]
+
+        if reference is not None:
+            self._align_with(reference, data)
+        else:
+            self._construct_mappers(data, config, set(int(c) for c in categorical_feature))
+            self._bin_data(data)
+        if keep_raw:
+            self.raw_data = data
+        if label is not None:
+            self.metadata.set_label(label)
+        else:
+            self.metadata.num_data = self.num_data
+        if weights is not None:
+            self.metadata.set_weights(weights)
+        if group is not None:
+            self.metadata.set_query_counts(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        return self
+
+    @classmethod
+    def from_file(cls, filename: str, config: Optional[Config] = None,
+                  reference: Optional["TrainingData"] = None) -> "TrainingData":
+        """CLI/file path (dataset_loader.cpp:159-216): parse, side files,
+        label column handling."""
+        config = config or Config()
+        label_idx = 0
+        header_names: Optional[List[str]] = None
+        if config.has_header:
+            header_names = _parser.read_header(filename)
+        if config.label_column:
+            lc = config.label_column
+            if lc.startswith("name:"):
+                name = lc[5:]
+                if not header_names or name not in header_names:
+                    Log.fatal("Could not find label column %s in data file", name)
+                label_idx = header_names.index(name)
+            else:
+                label_idx = int(lc)
+        parsed = _parser.parse_file(filename, has_header=config.has_header,
+                                    label_idx=label_idx)
+        feature_names = None
+        if header_names:
+            feature_names = [n for i, n in enumerate(header_names) if i != label_idx]
+        categorical = _resolve_columns(config.categorical_column, feature_names)
+        ignore = _resolve_columns(config.ignore_column, feature_names)
+        data = parsed.features
+        if ignore:
+            keep = [i for i in range(data.shape[1]) if i not in ignore]
+            data = data[:, keep]
+            if feature_names:
+                feature_names = [feature_names[i] for i in keep]
+            categorical = {keep.index(c) for c in categorical if c in keep}
+        self = cls.from_matrix(data, label=parsed.label, config=config,
+                               categorical_feature=sorted(categorical),
+                               feature_names=feature_names,
+                               reference=reference)
+        self.metadata.init_from_file(filename)
+        return self
+
+    def _construct_mappers(self, data: np.ndarray, config: Config,
+                           categorical: set) -> None:
+        n = self.num_data
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        rng = Random(config.data_random_seed)
+        sample_idx = rng.sample(n, sample_cnt)
+        if len(sample_idx) == 0:
+            sample_idx = np.arange(n, dtype=np.int32)
+        sample = data[sample_idx]
+        total_sample = len(sample_idx)
+        # filter_cnt formula from dataset_loader.cpp:491-492
+        filter_cnt = int(config.min_data_in_leaf * total_sample / max(n, 1))
+
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            col = sample[:, f]
+            col = col[~np.isnan(col)]
+            nonzero = col[col != 0.0]
+            m = BinMapper()
+            bin_type = CATEGORICAL if f in categorical else NUMERICAL
+            m.find_bin(nonzero, total_sample, config.max_bin,
+                       config.min_data_in_bin, filter_cnt, bin_type)
+            self.bin_mappers.append(m)
+
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if m is not None and not m.is_trivial]
+        if not self.used_feature_idx:
+            Log.warning("There are no meaningful features, as all feature values are constant.")
+        self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
+        self._build_feature_arrays()
+
+    def _align_with(self, reference: "TrainingData", data: np.ndarray) -> None:
+        """Valid set shares the train set's mappers
+        (dataset_loader.cpp:220-261 CreateValid path)."""
+        if data.shape[1] != reference.num_total_features:
+            Log.fatal("Validation data has %d features, train data has %d",
+                      data.shape[1], reference.num_total_features)
+        self.bin_mappers = reference.bin_mappers
+        self.used_feature_idx = list(reference.used_feature_idx)
+        self.real_to_inner = dict(reference.real_to_inner)
+        self.num_bin_arr = reference.num_bin_arr
+        self.default_bin_arr = reference.default_bin_arr
+        self.is_categorical_arr = reference.is_categorical_arr
+        self.max_bin = reference.max_bin
+        self._bin_data(data)
+
+    def _build_feature_arrays(self) -> None:
+        used = self.used_feature_idx
+        self.num_bin_arr = np.asarray(
+            [self.bin_mappers[r].num_bin for r in used], dtype=np.int32)
+        self.default_bin_arr = np.asarray(
+            [self.bin_mappers[r].default_bin for r in used], dtype=np.int32)
+        self.is_categorical_arr = np.asarray(
+            [self.bin_mappers[r].bin_type == CATEGORICAL for r in used], dtype=bool)
+
+    def _bin_data(self, data: np.ndarray) -> None:
+        n = data.shape[0]
+        self.num_data = n
+        f_used = len(self.used_feature_idx)
+        max_num_bin = int(self.num_bin_arr.max()) if f_used else 2
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        out = np.zeros((n, f_used), dtype=dtype)
+        for i, r in enumerate(self.used_feature_idx):
+            out[:, i] = self.bin_mappers[r].value_to_bin(data[:, r]).astype(dtype)
+        self.binned = out
+
+    # ------------------------------------------------------------- accessors
+    @property
+    def num_features(self) -> int:
+        return len(self.used_feature_idx)
+
+    def inner_feature_index(self, real_idx: int) -> int:
+        return self.real_to_inner.get(real_idx, -1)
+
+    def real_feature_index(self, inner_idx: int) -> int:
+        return self.used_feature_idx[inner_idx]
+
+    def real_threshold(self, inner_idx: int, threshold_bin: int) -> float:
+        """bin threshold -> real-valued threshold (dataset.h:457-462)."""
+        return self.bin_mappers[self.used_feature_idx[inner_idx]].bin_to_value(threshold_bin)
+
+    def feature_bin_mapper(self, inner_idx: int) -> BinMapper:
+        return self.bin_mappers[self.used_feature_idx[inner_idx]]
+
+    def feature_infos(self) -> List[str]:
+        """Per total-feature info string for the model file
+        (dataset.h:514-526)."""
+        out = []
+        for i in range(self.num_total_features):
+            if self.real_to_inner.get(i, -1) == -1:
+                out.append("none")
+            else:
+                out.append(self.bin_mappers[i].bin_info())
+        return out
+
+    def subset(self, indices: np.ndarray) -> "TrainingData":
+        """Bagging subset copy (dataset.cpp:399 CopySubset)."""
+        out = TrainingData()
+        out.num_data = len(indices)
+        out.num_total_features = self.num_total_features
+        out.bin_mappers = self.bin_mappers
+        out.used_feature_idx = self.used_feature_idx
+        out.real_to_inner = self.real_to_inner
+        out.num_bin_arr = self.num_bin_arr
+        out.default_bin_arr = self.default_bin_arr
+        out.is_categorical_arr = self.is_categorical_arr
+        out.max_bin = self.max_bin
+        out.feature_names = self.feature_names
+        out.binned = self.binned[indices]
+        out.metadata = self.metadata.subset(indices)
+        return out
+
+    # ------------------------------------------------------- binary file I/O
+    _BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+
+    def save_binary(self, filename: str) -> None:
+        """Binary dataset file (dataset.cpp:489 SaveBinaryFile analog)."""
+        meta = {
+            "magic": self._BINARY_MAGIC,
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "used_feature_idx": self.used_feature_idx,
+            "feature_names": self.feature_names,
+            "max_bin": self.max_bin,
+            "bin_mappers": [None if m is None else m.to_dict()
+                            for m in self.bin_mappers],
+        }
+        arrays = {"binned": self.binned}
+        if self.metadata.label is not None:
+            arrays["label"] = self.metadata.label
+        if self.metadata.weights is not None:
+            arrays["weights"] = self.metadata.weights
+        if self.metadata.query_boundaries is not None:
+            arrays["query_boundaries"] = self.metadata.query_boundaries
+        if self.metadata.init_score is not None:
+            arrays["init_score"] = self.metadata.init_score
+        np.savez_compressed(filename, meta=json.dumps(meta), **arrays)
+
+    @classmethod
+    def can_load_binary(cls, filename: str) -> bool:
+        try:
+            with np.load(filename, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"]))
+            return meta.get("magic") == cls._BINARY_MAGIC
+        except Exception:
+            return False
+
+    @classmethod
+    def load_binary(cls, filename: str) -> "TrainingData":
+        with np.load(filename, allow_pickle=False) as z:
+            meta = json.loads(str(z["meta"]))
+            if meta.get("magic") != cls._BINARY_MAGIC:
+                Log.fatal("Not a lightgbm_tpu binary dataset file: %s", filename)
+            self = cls()
+            self.num_data = meta["num_data"]
+            self.num_total_features = meta["num_total_features"]
+            self.used_feature_idx = list(meta["used_feature_idx"])
+            self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
+            self.feature_names = meta["feature_names"]
+            self.max_bin = meta["max_bin"]
+            self.bin_mappers = [None if d is None else BinMapper.from_dict(d)
+                                for d in meta["bin_mappers"]]
+            self.binned = z["binned"]
+            self.metadata = Metadata(self.num_data)
+            if "label" in z:
+                self.metadata.label = z["label"]
+            if "weights" in z:
+                self.metadata.weights = z["weights"]
+            if "query_boundaries" in z:
+                self.metadata.query_boundaries = z["query_boundaries"]
+            if "init_score" in z:
+                self.metadata.init_score = z["init_score"]
+            self._build_feature_arrays()
+        return self
+
+
+def _resolve_columns(spec: str, feature_names: Optional[List[str]]) -> set:
+    """Parse 'name:a,b,c' or '0,1,2' column specs (dataset_loader.cpp:22-120
+    SetHeader column-role resolution)."""
+    out: set = set()
+    if not spec:
+        return out
+    if spec.startswith("name:"):
+        names = spec[5:].split(",")
+        if feature_names:
+            for nm in names:
+                if nm in feature_names:
+                    out.add(feature_names.index(nm))
+                else:
+                    Log.warning("Could not find column %s in data file", nm)
+    else:
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if tok:
+                out.add(int(tok))
+    return out

@@ -1,0 +1,120 @@
+"""DART booster (src/boosting/dart.hpp:50-186).
+
+Drops a random subset of prior trees each iteration (uniform or
+weight-proportional), trains on the adjusted score, then re-normalizes the
+dropped trees — the lightgbm ``k/(k+1)`` scheme or ``xgboost_dart_mode``.
+
+Deviation from the reference: tree indices account for the
+boost_from_average stub tree (the reference indexes ``i * k + tid`` even when
+models_[0] is the stub, dropping the wrong tree in that configuration).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils.random import Random
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def __init__(self, config, train_data=None, objective=None,
+                 training_metrics=()):
+        super().__init__(config, train_data, objective, training_metrics)
+        self.random_for_drop = Random(config.drop_seed)
+        self.sum_weight = 0.0
+        self.tree_weight: List[float] = []
+        self.drop_index: List[int] = []
+        self._score_dropped_this_iter = False
+
+    def _stub_offset(self) -> int:
+        return 1 if self.boost_from_average_used else 0
+
+    def _tree_at(self, iteration: int, tid: int):
+        return self.models[self._stub_offset()
+                           + iteration * self.num_tree_per_iteration + tid]
+
+    def train_one_iter(self, gradients=None, hessians=None,
+                       is_eval: bool = True) -> bool:
+        self._score_dropped_this_iter = False
+        stop = super().train_one_iter(gradients, hessians, False)
+        if stop:
+            return stop
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def _score_for_objective(self):
+        # DroppingTrees runs once per iteration the moment scores are read
+        # (DART::GetTrainingScore, dart.hpp:69-79)
+        if not self._score_dropped_this_iter:
+            self._dropping_trees()
+            self._score_dropped_this_iter = True
+        return super()._score_for_objective()
+
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self.random_for_drop.next_float() < cfg.skip_drop
+        if not is_skip and self.iter > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / self.sum_weight \
+                    if self.sum_weight > 0 else 0.0
+                if cfg.max_drop > 0 and self.sum_weight > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter):
+                    if self.random_for_drop.next_float() < drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(i)
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter))
+                for i in range(self.iter):
+                    if self.random_for_drop.next_float() < drop_rate:
+                        self.drop_index.append(i)
+        # remove dropped trees' contribution from the training score
+        for i in self.drop_index:
+            for tid in range(self.num_tree_per_iteration):
+                tree = self._tree_at(i, tid)
+                tree.shrink(-1.0)
+                self._add_tree_score(tree, self.train_data, self.train_score[tid])
+        k = float(len(self.drop_index))
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            if not self.drop_index:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = cfg.learning_rate / (cfg.learning_rate + k)
+
+    def _normalize(self) -> None:
+        """dart.hpp:139-176 three-step shrink dance."""
+        cfg = self.config
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for tid in range(self.num_tree_per_iteration):
+                tree = self._tree_at(i, tid)
+                if not cfg.xgboost_dart_mode:
+                    tree.shrink(1.0 / (k + 1.0))
+                    for vd, vs in zip(self.valid_data, self.valid_score):
+                        self._add_tree_score(tree, vd, vs[tid])
+                    tree.shrink(-k)
+                    self._add_tree_score(tree, self.train_data, self.train_score[tid])
+                else:
+                    tree.shrink(self.shrinkage_rate)
+                    for vd, vs in zip(self.valid_data, self.valid_score):
+                        self._add_tree_score(tree, vd, vs[tid])
+                    tree.shrink(-k / cfg.learning_rate)
+                    self._add_tree_score(tree, self.train_data, self.train_score[tid])
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                    self.tree_weight[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i] *= k / (k + cfg.learning_rate)

@@ -1,0 +1,18 @@
+"""Boosting factory (src/boosting/boosting.cpp:30-62)."""
+from __future__ import annotations
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+def create_boosting(boosting_type: str, config, train_data=None,
+                    objective=None, training_metrics=()):
+    from .dart import DART
+    from .goss import GOSS
+    from .infiniteboost import InfiniteBoost
+    types = {"gbdt": GBDT, "gbrt": GBDT, "dart": DART, "goss": GOSS,
+             "infinite": InfiniteBoost, "infiniteboost": InfiniteBoost}
+    cls = types.get(boosting_type)
+    if cls is None:
+        Log.fatal("Unknown boosting type %s", boosting_type)
+    return cls(config, train_data, objective, training_metrics)

@@ -1,0 +1,59 @@
+"""GOSS booster (src/boosting/goss.hpp).
+
+Gradient-based One-Side Sampling: keep the top ``top_rate`` fraction of rows
+by |g*h| (summed over classes), sample ``other_rate`` of the rest uniformly
+and amplify their gradients by (1-top_rate-ish) factor
+``(cnt - top_k) / other_k`` (goss.hpp:79-125).  No sampling for the first
+``1 / learning_rate`` iterations (goss.hpp:128-130).
+
+Realized as the row-multiplier mask the TPU learner already consumes —
+gradient amplification is applied in place to the gradient arrays, exactly
+like the reference mutates ``gradients_``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def __init__(self, config, train_data=None, objective=None,
+                 training_metrics=()):
+        super().__init__(config, train_data, objective, training_metrics)
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            Log.fatal("cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+        if train_data is not None:
+            # GOSS owns bagging entirely
+            self.bag_data_cnt = self.num_data
+
+    def _bagging(self, it: int, gradients=None, hessians=None) -> None:
+        cfg = self.config
+        self.row_mult = None
+        if it < int(1.0 / cfg.learning_rate):
+            return
+        if gradients is None:
+            return
+        n = self.num_data
+        g = np.abs(np.asarray(gradients) * np.asarray(hessians)).reshape(
+            self.num_tree_per_iteration, n).sum(axis=0)
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = int(n * cfg.other_rate)
+        order = np.argpartition(-g, top_k - 1)
+        threshold = g[order[top_k - 1]]
+        is_top = g >= threshold
+        rest_idx = np.nonzero(~is_top)[0]
+        mult = np.zeros(n, dtype=np.float32)
+        mult[is_top] = 1.0
+        if other_k > 0 and len(rest_idx) > 0:
+            rng = np.random.default_rng(cfg.bagging_seed + it)
+            take = min(other_k, len(rest_idx))
+            sampled = rng.choice(rest_idx, size=take, replace=False)
+            mult[sampled] = 1.0
+            multiply = (n - top_k) / other_k
+            for tid in range(self.num_tree_per_iteration):
+                gradients[tid][sampled] *= multiply
+                hessians[tid][sampled] *= multiply
+        self.row_mult = mult

@@ -1,0 +1,70 @@
+"""InfiniteBoost booster (src/boosting/infiniteboost.hpp, arXiv:1706.01109).
+
+Trains with shrinkage 1, then re-weights each new tree so the ensemble
+converges to a capacity-bounded F:  eta_m = 2/(m+1) contribution,
+F -> (1-eta)F + eta*capacity*tree, final tree weight
+``capacity * m / sum(1..n)`` with a 0.2 max contribution
+(infiniteboost.hpp:70-113).
+
+Deviation from the reference: tree indices account for the
+boost_from_average stub tree.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .gbdt import GBDT
+
+MAXIMAL_CONTRIBUTION = 0.2
+
+
+class InfiniteBoost(GBDT):
+    def __init__(self, config, train_data=None, objective=None,
+                 training_metrics=()):
+        super().__init__(config, train_data, objective, training_metrics)
+        self.capacity = float(config.capacity)
+        # ensemble built with unit shrinkage (infiniteboost.hpp:41)
+        self.shrinkage_rate = 1.0
+        n = config.num_iterations
+        self.normalization = n * (n + 1) / 2.0
+        self.current_normalization = 0.0
+
+    def _stub_offset(self) -> int:
+        return 1 if self.boost_from_average_used else 0
+
+    def train_one_iter(self, gradients=None, hessians=None,
+                       is_eval: bool = True) -> bool:
+        stop = super().train_one_iter(gradients, hessians, False)
+        if stop:
+            return stop
+        self._update_tree_weight()
+        if is_eval:
+            self.output_metric(self.iter)
+        return False
+
+    def _update_tree_weight(self) -> None:
+        """infiniteboost.hpp:70-113."""
+        m = self.iter
+        eta = 2.0 / (m + 1)
+        tree_contribution = min(eta * self.capacity, MAXIMAL_CONTRIBUTION)
+        self.current_normalization += m
+        k = self.num_tree_per_iteration
+        for tid in range(k):
+            tree = self.models[self._stub_offset() + (m - 1) * k + tid]
+            # remove GBDT's contribution, scale F by (1-eta), add back with
+            # the capped contribution
+            tree.shrink(-1.0)
+            for vd, vs in zip(self.valid_data, self.valid_score):
+                self._add_tree_score(tree, vd, vs[tid])
+                vs[tid] *= (1.0 - eta)
+            self._add_tree_score(tree, self.train_data, self.train_score[tid])
+            self.train_score[tid] *= (1.0 - eta)
+        for tid in range(k):
+            tree = self.models[self._stub_offset() + (m - 1) * k + tid]
+            tree.shrink(-tree_contribution)
+            for vd, vs in zip(self.valid_data, self.valid_score):
+                self._add_tree_score(tree, vd, vs[tid])
+            self._add_tree_score(tree, self.train_data, self.train_score[tid])
+            tree.shrink(1.0 / tree_contribution * min(
+                self.capacity * m / self.normalization,
+                MAXIMAL_CONTRIBUTION * self.current_normalization / self.normalization))

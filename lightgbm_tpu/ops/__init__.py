@@ -1,0 +1,7 @@
+from .histogram import leaf_histogram, leaf_sums
+from .split_finder import find_best_split, FeatureMeta, SplitParams
+from .partition import apply_split
+from .learner import SerialTreeLearner
+
+__all__ = ["leaf_histogram", "leaf_sums", "find_best_split", "FeatureMeta",
+           "SplitParams", "apply_split", "SerialTreeLearner"]

@@ -1,0 +1,105 @@
+"""Leaf histogram construction — the hottest op, in XLA.
+
+Parity target: the reference's scatter-add kernels (dense_bin.hpp:66-98 on
+CPU, src/treelearner/ocl/histogram*.cl on GPU).  TPU-first design instead of
+a translation:
+
+* ``scatter`` mode: one `segment_sum` per feature (vmapped), which XLA lowers
+  to parallel scatter-adds.  Works on every backend; preferred on CPU.
+* ``onehot`` mode: rows are processed in chunks; each chunk builds a
+  (C, B) one-hot in bf16/f32 per feature block and contracts it against the
+  (C, 3) weight matrix on the MXU — the `max_bin=63` lesson from
+  docs/GPU-Performance.md:58-64 maps to "small B lives on the MXU".
+
+Rows outside the target leaf contribute zero via the mask multiplier, which
+also carries bagging/GOSS per-row weights (gbdt.cpp:265-324, goss.hpp:79-129
+fold into the same mechanism).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _weights(grad, hess, leaf_id, leaf, row_mult):
+    """(N, 3) [g, h, 1] masked to the target leaf and row multipliers."""
+    mask = (leaf_id == leaf).astype(grad.dtype)
+    if row_mult is not None:
+        mask = mask * row_mult
+    return jnp.stack([grad * mask, hess * mask, mask], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def leaf_histogram_scatter(binned, grad, hess, leaf_id, leaf, row_mult,
+                           num_bins: int):
+    """(F, B, 3) histogram of the target leaf via per-feature segment_sum.
+
+    binned: (N, F) uint8/uint16 bin ids; grad/hess: (N,) float;
+    leaf_id: (N,) int32; leaf: scalar int; row_mult: (N,) float or None.
+    """
+    w = _weights(grad, hess, leaf_id, leaf, row_mult)  # (N, 3)
+
+    def per_feature(col):
+        return jax.ops.segment_sum(w, col.astype(jnp.int32),
+                                   num_segments=num_bins)
+
+    return jax.vmap(per_feature, in_axes=1)(binned)   # (F, B, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk"))
+def leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf, row_mult,
+                          num_bins: int, chunk: int = 16384):
+    """(F, B, 3) histogram via chunked one-hot matmul on the MXU.
+
+    For each row chunk: one_hot(bins) (C, F, B) contracted with weights
+    (C, 3) -> (F, B, 3), accumulated over chunks with lax.scan so the
+    one-hot tensor never exceeds chunk x F x B.
+    """
+    n, f = binned.shape
+    w = _weights(grad, hess, leaf_id, leaf, row_mult)  # (N, 3)
+    pad = (-n) % chunk
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    nchunks = (n + pad) // chunk
+    xb = binned.reshape(nchunks, chunk, f)
+    wb = w.reshape(nchunks, chunk, 3)
+
+    def step(acc, args):
+        xc, wc = args
+        onehot = jax.nn.one_hot(xc.astype(jnp.int32), num_bins,
+                                dtype=wc.dtype)          # (C, F, B)
+        acc = acc + jnp.einsum("cfb,cw->fbw", onehot, wc,
+                               preferred_element_type=wc.dtype)
+        return acc, None
+
+    init = jnp.zeros((f, num_bins, 3), dtype=w.dtype)
+    hist, _ = lax.scan(step, init, (xb, wb))
+    return hist
+
+
+def leaf_histogram(binned, grad, hess, leaf_id, leaf, row_mult,
+                   num_bins: int, mode: str = "auto"):
+    """Dispatch by mode; 'auto' picks scatter on CPU, onehot on TPU for
+    small bin counts (MXU-friendly), scatter otherwise."""
+    if mode == "auto":
+        platform = jax.default_backend()
+        if platform == "tpu" and num_bins <= 64:
+            mode = "onehot"
+        else:
+            mode = "scatter"
+    if mode == "onehot":
+        return leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf,
+                                     row_mult, num_bins=num_bins)
+    return leaf_histogram_scatter(binned, grad, hess, leaf_id, leaf,
+                                  row_mult, num_bins=num_bins)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def leaf_sums(grad, hess, leaf_id, leaf, row_mult):
+    """Leaf total (sum_g, sum_h, count) — LeafSplits::Init (leaf_splits.hpp)."""
+    w = _weights(grad, hess, leaf_id, leaf, row_mult)
+    return jnp.sum(w, axis=0)

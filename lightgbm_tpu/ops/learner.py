@@ -1,0 +1,222 @@
+"""Leaf-wise (best-first) tree learner driving the XLA ops.
+
+Parity target: src/treelearner/serial_tree_learner.cpp:168-223 — the same
+grow loop (root sums -> repeat: construct smaller-leaf histogram, derive the
+larger leaf by subtraction (feature_histogram.hpp:63-69), best-split scan,
+split the winning leaf) with the device doing all O(N) work:
+
+* histograms: ops.histogram.leaf_histogram (masked scatter / one-hot matmul);
+* split search: ops.split_finder.find_best_split (whole-histogram scan);
+* partition: ops.partition.apply_split (masked leaf_id rewrite).
+
+The host keeps only the tiny per-leaf bookkeeping (sums, gains, tree arrays),
+mirroring how the GPU learner kept control flow on CPU
+(gpu_tree_learner.cpp:977-1072).  Under data-parallel sharding the same code
+runs unchanged: the histogram reduction becomes a psum across the row-sharded
+mesh (see parallel/mesh.py), which is the reference's ReduceScatter path
+(data_parallel_tree_learner.cpp:148-222) collapsed into XLA collectives.
+
+Bagging and GOSS enter through ``row_mult`` — a per-row multiplier folded
+into histogram weights, replacing bag-index re-partitioning
+(gbdt.cpp:265-324).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.dataset import TrainingData
+from ..models.tree import Tree
+from ..utils.config import Config
+from ..utils.random import Random
+from .histogram import leaf_histogram, leaf_sums
+from .partition import apply_split
+from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
+                           LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
+                           RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
+                           THRESHOLD, FeatureMeta, SplitParams, find_best_split)
+
+
+class SerialTreeLearner:
+    """One tree per call; reused across iterations (TreeLearner::Train)."""
+
+    def __init__(self, config: Config, train_data: TrainingData):
+        self.config = config
+        self.train_data = train_data
+        self.num_leaves = config.num_leaves
+        self.max_depth = config.max_depth
+        self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
+        self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
+        self.X = jnp.asarray(train_data.binned)
+        self.meta = FeatureMeta(
+            num_bin=jnp.asarray(train_data.num_bin_arr),
+            default_bin=jnp.asarray(train_data.default_bin_arr),
+            is_categorical=jnp.asarray(train_data.is_categorical_arr),
+        )
+        self.params = SplitParams(
+            lambda_l1=float(config.lambda_l1),
+            lambda_l2=float(config.lambda_l2),
+            min_gain_to_split=float(config.min_gain_to_split),
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+            use_missing=bool(config.use_missing),
+        )
+        self.hist_mode = config.tpu_histogram_mode
+        # feature_fraction RNG persists across trees
+        # (serial_tree_learner.cpp:40-96 Init + :257-275 BeforeTrain)
+        self._feature_rng = Random(config.feature_fraction_seed)
+        self.leaf_id: Optional[jnp.ndarray] = None
+
+    # ------------------------------------------------------------ internals
+    def _sample_features(self) -> np.ndarray:
+        f = self.train_data.num_features
+        mask = np.ones(f, dtype=bool)
+        if self.config.feature_fraction < 1.0:
+            used_cnt = int(f * self.config.feature_fraction)
+            idx = self._feature_rng.sample(f, used_cnt)
+            mask[:] = False
+            mask[idx] = True
+        return mask
+
+    def _depth_ok(self, depth: int) -> bool:
+        return self.max_depth <= 0 or depth < self.max_depth
+
+    # ----------------------------------------------------------------- train
+    def train(self, grad, hess, row_mult=None) -> Tuple[Tree, jnp.ndarray]:
+        """Grow one tree; returns (tree, final per-row leaf assignment)."""
+        td = self.train_data
+        n = td.num_data
+        grad = jnp.asarray(grad, self.dtype)
+        hess = jnp.asarray(hess, self.dtype)
+        if row_mult is not None:
+            row_mult = jnp.asarray(row_mult, self.dtype)
+        feature_mask = jnp.asarray(self._sample_features())
+
+        leaf_id = jnp.zeros(n, dtype=jnp.int32)
+        tree = Tree(self.num_leaves)
+        if td.num_features == 0:
+            return tree, leaf_id
+
+        root = np.asarray(leaf_sums(grad, hess, leaf_id, 0, row_mult))
+        hists: Dict[int, jnp.ndarray] = {}
+        bests: Dict[int, np.ndarray] = {}
+        sums: Dict[int, Tuple[float, float, float]] = {0: tuple(root)}
+
+        hists[0] = leaf_histogram(self.X, grad, hess, leaf_id, 0, row_mult,
+                                  self.num_bins, self.hist_mode)
+        bests[0] = np.asarray(find_best_split(
+            hists[0], root[0], root[1], root[2], self.meta, feature_mask,
+            self.params))
+        if not self._depth_ok(0):
+            bests[0][GAIN] = -np.inf
+
+        for _ in range(self.num_leaves - 1):
+            # global best leaf (ArgMax over best_split_per_leaf_,
+            # serial_tree_learner.cpp:203)
+            best_leaf, best_gain = -1, 0.0
+            for leaf, b in bests.items():
+                if np.isfinite(b[GAIN]) and b[GAIN] > best_gain:
+                    best_leaf, best_gain = leaf, b[GAIN]
+            if best_leaf < 0:
+                break
+            info = bests.pop(best_leaf)
+            inner_f = int(info[FEATURE])
+            thr_bin = int(info[THRESHOLD])
+            dbz = int(info[DEFAULT_BIN_FOR_ZERO])
+            is_cat = bool(info[IS_CAT])
+            mapper = td.feature_bin_mapper(inner_f)
+            default_bin = mapper.default_bin
+            real_f = td.real_feature_index(inner_f)
+            # default_value only differs from 0 when the zero bin moved
+            # (serial_tree_learner.cpp:546-549)
+            default_value = 0.0
+            if default_bin != dbz:
+                default_value = td.real_threshold(inner_f, dbz)
+
+            right_leaf = tree.split(
+                best_leaf, inner_f, is_cat, thr_bin, real_f,
+                td.real_threshold(inner_f, thr_bin),
+                float(info[LEFT_OUTPUT]), float(info[RIGHT_OUTPUT]),
+                int(info[LEFT_COUNT]), int(info[RIGHT_COUNT]),
+                float(info[GAIN]), default_bin, dbz, default_value)
+
+            default_left = (dbz == thr_bin) if is_cat else (dbz <= thr_bin)
+            leaf_id = apply_split(self.X, leaf_id, best_leaf, inner_f, thr_bin,
+                                  default_bin, default_left, is_cat, right_leaf)
+
+            left_sums = (float(info[LEFT_SUM_G]), float(info[LEFT_SUM_H]),
+                         float(info[LEFT_COUNT]))
+            right_sums = (float(info[RIGHT_SUM_G]), float(info[RIGHT_SUM_H]),
+                          float(info[RIGHT_COUNT]))
+            sums[best_leaf] = left_sums
+            sums[right_leaf] = right_sums
+
+            if tree.num_leaves >= self.num_leaves:
+                break
+
+            # smaller child scanned, larger derived by subtraction
+            # (serial_tree_learner.cpp:452-534)
+            if info[LEFT_COUNT] < info[RIGHT_COUNT]:
+                small, large = best_leaf, right_leaf
+            else:
+                small, large = right_leaf, best_leaf
+            parent_hist = hists.pop(best_leaf)
+            hist_small = leaf_histogram(self.X, grad, hess, leaf_id, small,
+                                        row_mult, self.num_bins, self.hist_mode)
+            hist_large = parent_hist - hist_small
+            hists[small] = hist_small
+            hists[large] = hist_large
+
+            depth = tree.depth_of_leaf(best_leaf)
+            for child, hist in ((small, hist_small), (large, hist_large)):
+                sg, sh, sc = sums[child]
+                b = np.asarray(find_best_split(
+                    hist, sg, sh, sc, self.meta, feature_mask, self.params))
+                if not self._depth_ok(depth):
+                    b[GAIN] = -np.inf
+                bests[child] = b
+
+        self.leaf_id = leaf_id
+        return tree, leaf_id
+
+    # ------------------------------------------------------------ DART refit
+    def fit_by_existing_tree(self, tree: Tree, grad, hess) -> Tree:
+        """Refit leaf outputs of an existing structure on new gradients
+        (SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:225-250).
+        """
+        leaves = self._leaf_index_binned(tree)
+        grad = np.asarray(grad, dtype=np.float64)
+        hess = np.asarray(hess, dtype=np.float64)
+        l1, l2 = self.config.lambda_l1, self.config.lambda_l2
+        for leaf in range(tree.num_leaves):
+            m = leaves == leaf
+            sum_g = grad[m].sum()
+            sum_h = hess[m].sum()
+            reg = max(abs(sum_g) - l1, 0.0)
+            out = -np.sign(sum_g) * reg / (sum_h + l2 + 1e-15)
+            tree.set_leaf_value(leaf, out)
+        return tree
+
+    def _leaf_index_binned(self, tree: Tree) -> np.ndarray:
+        binned = self.train_data.binned
+        n = binned.shape[0]
+        if tree.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = node >= 0
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            b = binned[idx, tree.split_feature_inner[nd]].astype(np.int64)
+            th = tree.threshold_in_bin[nd]
+            is_cat = tree.decision_type[nd] == 1
+            go_left = np.where(is_cat, b == th, b <= th)
+            is_def = b == tree.zero_bin[nd]
+            dbz = tree.default_bin_for_zero[nd]
+            def_left = np.where(is_cat, dbz == th, dbz <= th)
+            go_left = np.where(is_def, def_left, go_left)
+            node[idx] = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
+            active = node >= 0
+        return (~node).astype(np.int32)

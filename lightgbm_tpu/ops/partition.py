@@ -1,0 +1,44 @@
+"""Leaf-membership updates — the TPU replacement for DataPartition.
+
+The reference keeps a leaf-ordered index array and does a stable in-place
+partition per split (data_partition.hpp:94-147).  On TPU the natural
+structure is a per-row ``leaf_id`` vector updated with a masked where — no
+data movement, fully parallel, and identical semantics to
+DenseBin::Split (dense_bin.hpp:190-222):
+
+* rows in the default (zero) bin go to the side holding default_bin_for_zero
+  (numerical: dbz <= threshold -> left; categorical: dbz == threshold -> left);
+* otherwise numerical goes left iff bin <= threshold, categorical iff
+  bin == threshold.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def apply_split(binned, leaf_id, leaf, feature, threshold, default_bin,
+                default_left, is_cat, right_leaf):
+    """Route rows of `leaf` to left (keep id) or right (new id).
+
+    All of feature/threshold/... may be traced scalars so one compiled
+    program serves every split.
+    """
+    col = jnp.take(binned, feature, axis=1).astype(jnp.int32)
+    in_leaf = leaf_id == leaf
+    go_left_num = col <= threshold
+    go_left_cat = col == threshold
+    go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+    go_left = jnp.where(col == default_bin, default_left, go_left)
+    new_id = jnp.where(in_leaf & ~go_left, right_leaf, leaf_id)
+    return new_id
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def leaf_outputs_to_scores(leaf_id, leaf_values, num_leaves: int):
+    """Gather per-row tree output from leaf assignments (train-set score
+    update via the partition, gbdt.cpp:502-515)."""
+    return jnp.take(leaf_values, jnp.clip(leaf_id, 0, num_leaves - 1))

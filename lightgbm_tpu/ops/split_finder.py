@@ -1,0 +1,279 @@
+"""Vectorized best-split search over (feature, bin) histograms — XLA native.
+
+Parity target: src/treelearner/feature_histogram.hpp:78-387.  The reference
+scans each feature's histogram sequentially (up to 3 passes to place the
+zero/default bin left, right, or in natural position).  Here every pass is a
+masked cumulative-sum over the whole (F, B) histogram tensor, so the entire
+split search for a leaf is one fused XLA program — no per-feature loop, no
+host round-trips.  Tie-breaking reproduces the reference's iteration order:
+
+* dir=-1 passes iterate bins high->low with strict ``>`` updates, so equal
+  gains keep the LARGER threshold; dir=+1 keeps the smaller.
+* across passes, earlier passes win ties (strict ``>`` replacement,
+  feature_histogram.hpp:88-97);
+* across features, the smaller feature index wins ties
+  (SplitInfo comparison, split_info.hpp:102-107 — argmax picks first max).
+
+Gain / leaf-output formulas with L1/L2 and the kEpsilon seeding match
+GetLeafSplitGain / CalculateSplittedLeafOutput (feature_histogram.hpp:230-249)
+bit-for-bit in the chosen dtype.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+kEpsilon = 1e-15
+
+# packed SplitInfo layout (one float vector per leaf; device-resident)
+GAIN = 0
+FEATURE = 1
+THRESHOLD = 2
+DEFAULT_BIN_FOR_ZERO = 3
+LEFT_OUTPUT = 4
+RIGHT_OUTPUT = 5
+LEFT_SUM_G = 6
+LEFT_SUM_H = 7
+LEFT_COUNT = 8
+RIGHT_SUM_G = 9
+RIGHT_SUM_H = 10
+RIGHT_COUNT = 11
+IS_CAT = 12
+SPLIT_VEC_SIZE = 13
+
+
+class FeatureMeta(NamedTuple):
+    """Static per-inner-feature arrays living on device."""
+    num_bin: jnp.ndarray        # (F,) int32
+    default_bin: jnp.ndarray    # (F,) int32
+    is_categorical: jnp.ndarray  # (F,) bool
+
+
+class SplitParams(NamedTuple):
+    """Python-scalar hyperparameters (static under jit closure)."""
+    lambda_l1: float
+    lambda_l2: float
+    min_gain_to_split: float
+    min_data_in_leaf: float
+    min_sum_hessian_in_leaf: float
+    use_missing: bool
+
+
+def _leaf_split_gain(sum_g, sum_h, l1, l2):
+    """GetLeafSplitGain (feature_histogram.hpp:230-236)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return reg * reg / (sum_h + l2)
+
+
+def _leaf_output(sum_g, sum_h, l1, l2):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:244-249)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return -jnp.sign(sum_g) * reg / (sum_h + l2)
+
+
+def _suffix_sum(x):
+    """sr[t] = sum_{b >= t} x[b] along the last axis."""
+    return jnp.flip(jnp.cumsum(jnp.flip(x, axis=-1), axis=-1), axis=-1)
+
+
+def _argmax_prefer_last(x):
+    """argmax that returns the LAST index among ties (descending scan order)."""
+    n = x.shape[-1]
+    return n - 1 - jnp.argmax(jnp.flip(x, axis=-1), axis=-1)
+
+
+class _Cand(NamedTuple):
+    gain: jnp.ndarray       # (F,) candidate gain, -inf when invalid
+    threshold: jnp.ndarray  # (F,) int32
+    dbz: jnp.ndarray        # (F,) int32 default_bin_for_zero
+    left_g: jnp.ndarray     # (F,)
+    left_h: jnp.ndarray     # (F,) includes +kEpsilon seed
+    left_c: jnp.ndarray     # (F,)
+
+
+def _numerical_pass(g, h, c, meta: FeatureMeta, params: SplitParams,
+                    total_g, total_h_eps, total_cnt,
+                    min_gain_shift, mode: str) -> _Cand:
+    """One FindBestThresholdSequence pass, vectorized over all features.
+
+    mode: 'zero_left' (dbz=0), 'natural' (dbz=default_bin),
+          'zero_right' (dbz=num_bin-1).
+    """
+    F, B = g.shape
+    bins = jnp.arange(B, dtype=jnp.int32)
+    valid = bins[None, :] < meta.num_bin[:, None]
+    skip_default = mode in ("zero_left", "zero_right")
+    if skip_default:
+        keep = valid & (bins[None, :] != meta.default_bin[:, None])
+    else:
+        keep = valid
+    gk = jnp.where(keep, g, 0.0)
+    hk = jnp.where(keep, h, 0.0)
+    ck = jnp.where(keep, c, 0.0)
+
+    eps = jnp.asarray(kEpsilon, g.dtype)
+    if mode != "zero_right":
+        # dir = -1: accumulate right side from the top bin down; split point t
+        # puts bins >= t on the right, threshold = t-1
+        right_g = _suffix_sum(gk)
+        right_h = _suffix_sum(hk) + eps
+        right_c = _suffix_sum(ck)
+        left_g = total_g - right_g
+        left_h = total_h_eps - right_h
+        left_c = total_cnt - right_c
+        t_ok = (bins[None, :] >= 1) & valid
+        threshold = bins[None, :] - 1
+        prefer_last = True
+    else:
+        # dir = +1: accumulate left side from bin 0 up; threshold = t
+        left_g = jnp.cumsum(gk, axis=-1)
+        left_h = jnp.cumsum(hk, axis=-1) + eps
+        left_c = jnp.cumsum(ck, axis=-1)
+        right_g = total_g - left_g
+        right_h = total_h_eps - left_h
+        right_c = total_cnt - left_c
+        t_ok = (bins[None, :] <= meta.num_bin[:, None] - 2) & valid
+        threshold = jnp.broadcast_to(bins[None, :], (F, B))
+        prefer_last = False
+
+    ok = (t_ok
+          & (right_c >= params.min_data_in_leaf)
+          & (right_h >= params.min_sum_hessian_in_leaf)
+          & (left_c >= params.min_data_in_leaf)
+          & (left_h >= params.min_sum_hessian_in_leaf))
+    gain = (_leaf_split_gain(left_g, left_h, params.lambda_l1, params.lambda_l2)
+            + _leaf_split_gain(right_g, right_h, params.lambda_l1, params.lambda_l2))
+    ok = ok & (gain > min_gain_shift)
+    gain = jnp.where(ok, gain, -jnp.inf)
+
+    pick = _argmax_prefer_last(gain) if prefer_last else jnp.argmax(gain, axis=-1)
+    fidx = jnp.arange(F)
+    best_gain = gain[fidx, pick]
+    if mode == "zero_left":
+        dbz = jnp.zeros(F, jnp.int32)
+    elif mode == "natural":
+        dbz = meta.default_bin
+    else:
+        dbz = meta.num_bin - 1
+    return _Cand(
+        gain=best_gain,
+        threshold=threshold[fidx, pick].astype(jnp.int32),
+        dbz=dbz,
+        left_g=left_g[fidx, pick],
+        left_h=left_h[fidx, pick],
+        left_c=left_c[fidx, pick],
+    )
+
+
+def _categorical_pass(g, h, c, meta: FeatureMeta, params: SplitParams,
+                      total_g, total_h_eps, total_cnt,
+                      min_gain_shift) -> _Cand:
+    """One-vs-rest categorical scan (feature_histogram.hpp:100-198); left side
+    is the single category bin t; ties keep the larger t (descending loop)."""
+    F, B = g.shape
+    bins = jnp.arange(B, dtype=jnp.int32)
+    valid = bins[None, :] < meta.num_bin[:, None]
+    eps = jnp.asarray(kEpsilon, g.dtype)
+
+    other_c = total_cnt - c
+    other_h = total_h_eps - h - eps
+    other_g = total_g - g
+    ok = (valid
+          & (c >= params.min_data_in_leaf)
+          & (h >= params.min_sum_hessian_in_leaf)
+          & (other_c >= params.min_data_in_leaf)
+          & (other_h >= params.min_sum_hessian_in_leaf))
+    gain = (_leaf_split_gain(other_g, other_h, params.lambda_l1, params.lambda_l2)
+            + _leaf_split_gain(g, h + eps, params.lambda_l1, params.lambda_l2))
+    ok = ok & (gain > min_gain_shift)
+    gain = jnp.where(ok, gain, -jnp.inf)
+
+    pick = _argmax_prefer_last(gain)
+    fidx = jnp.arange(F)
+    return _Cand(
+        gain=gain[fidx, pick],
+        threshold=pick.astype(jnp.int32),
+        dbz=meta.default_bin,
+        left_g=g[fidx, pick],
+        left_h=h[fidx, pick] + eps,
+        left_c=c[fidx, pick],
+    )
+
+
+def _merge(best: _Cand, cand: _Cand) -> _Cand:
+    """Later candidate replaces only on strictly greater gain."""
+    take = cand.gain > best.gain
+    return _Cand(*[jnp.where(take, cn, bn) for cn, bn in zip(cand, best)])
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist, total_g, total_h, total_cnt,
+                    meta: FeatureMeta, feature_mask, params: SplitParams):
+    """Best split for one leaf.
+
+    Args:
+      hist: (F, B, 3) float histogram [sum_grad, sum_hess, count].
+      total_g / total_h / total_cnt: leaf totals (scalars).
+      meta: FeatureMeta arrays.
+      feature_mask: (F,) bool — feature_fraction sampling for this tree.
+      params: SplitParams (static).
+
+    Returns: packed (SPLIT_VEC_SIZE,) vector; gain=-inf when unsplittable.
+    """
+    g = hist[..., 0]
+    h = hist[..., 1]
+    c = hist[..., 2]
+    dtype = g.dtype
+    eps = jnp.asarray(kEpsilon, dtype)
+    total_g = jnp.asarray(total_g, dtype)
+    total_h_eps = jnp.asarray(total_h, dtype) + 2 * eps
+    total_cnt = jnp.asarray(total_cnt, dtype)
+
+    gain_shift = _leaf_split_gain(total_g, total_h_eps,
+                                  params.lambda_l1, params.lambda_l2)
+    min_gain_shift = gain_shift + params.min_gain_to_split
+
+    args = (g, h, c, meta, params, total_g, total_h_eps, total_cnt, min_gain_shift)
+    if params.use_missing:
+        best = _numerical_pass(*args, mode="zero_left")
+        best = _merge(best, _numerical_pass(*args, mode="natural"))
+        best = _merge(best, _numerical_pass(*args, mode="zero_right"))
+    else:
+        best = _numerical_pass(*args, mode="natural")
+    # the 'natural' pass with an edge default_bin duplicates a skip pass; the
+    # reference guards those duplicates, we simply let _merge's strict >
+    # keep the earlier pass.  Edge default bins are handled identically.
+    cat = _categorical_pass(g, h, c, meta, params, total_g, total_h_eps,
+                            total_cnt, min_gain_shift)
+    best = _Cand(*[jnp.where(meta.is_categorical, cn, bn)
+                   for cn, bn in zip(cat, best)])
+
+    masked_gain = jnp.where(feature_mask, best.gain, -jnp.inf)
+    f = jnp.argmax(masked_gain)          # ties -> smaller feature index
+    bgain = masked_gain[f]
+    lg, lh, lc = best.left_g[f], best.left_h[f], best.left_c[f]
+    rg = total_g - lg
+    rh = total_h_eps - lh
+    rc = total_cnt - lc
+    out = jnp.stack([
+        bgain - min_gain_shift,
+        f.astype(dtype),
+        best.threshold[f].astype(dtype),
+        best.dbz[f].astype(dtype),
+        _leaf_output(lg, lh, params.lambda_l1, params.lambda_l2),
+        _leaf_output(rg, rh, params.lambda_l1, params.lambda_l2),
+        lg,
+        lh - eps,
+        lc,
+        rg,
+        rh - eps,
+        rc,
+        meta.is_categorical[f].astype(dtype),
+    ])
+    # keep -inf gain truly -inf (the subtraction above turns it into nan)
+    out = out.at[GAIN].set(jnp.where(jnp.isfinite(bgain),
+                                     bgain - min_gain_shift, -jnp.inf))
+    return out

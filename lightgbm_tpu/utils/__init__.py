@@ -1,0 +1,6 @@
+from .log import Log, LightGBMError
+from .config import Config, key_alias_transform, param_dict_to_str
+from .random import Random
+
+__all__ = ["Log", "LightGBMError", "Config", "key_alias_transform",
+           "param_dict_to_str", "Random"]

@@ -1,0 +1,63 @@
+"""Small helpers shared across layers (mirrors utils/common.h roles)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def array_to_string(arr, high_precision: bool = False) -> str:
+    """Space-joined array serialization as Common::ArrayToString renders it."""
+    out = []
+    for v in arr:
+        if isinstance(v, (np.floating, float)):
+            if high_precision:
+                out.append(repr(float(v)))
+            else:
+                out.append(_format_double(float(v)))
+        else:
+            out.append(str(int(v)))
+    return " ".join(out)
+
+
+def _format_double(v: float) -> str:
+    # C++ default stream precision is 6 significant digits; the model files
+    # round-trip through this.  We keep full precision instead (loaders on
+    # both sides parse it fine and it preserves exact re-load equality).
+    return repr(v)
+
+
+def string_to_array(s: str, dtype) -> np.ndarray:
+    if not s:
+        return np.asarray([], dtype=dtype)
+    return np.asarray(s.split(" "), dtype=dtype)
+
+
+def parse_kv_lines(lines: List[str]) -> Dict[str, str]:
+    """key=value lines -> dict (Common::Split on first '=')."""
+    out: Dict[str, str] = {}
+    for line in lines:
+        if "=" in line:
+            key, _, val = line.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if key and val:
+                out[key] = val
+    return out
+
+
+def avoid_inf(v: float) -> float:
+    """Common::AvoidInf — clamp ±inf to ±1e300 for serialization."""
+    if np.isnan(v):
+        return 0.0
+    if v == np.inf:
+        return 1e300
+    if v == -np.inf:
+        return -1e300
+    return float(v)
+
+
+kEpsilon = 1e-15
+kMissingValueRange = 1e-20
+kMaxTreeOutput = 100.0
+kMinScore = -np.inf

@@ -1,0 +1,416 @@
+"""Parameter system: canonical keys, aliases, typed defaults, conflict checks.
+
+Parity target: include/LightGBM/config.h:87-489 and src/io/config.cpp.  The
+parameter names and alias table are the de-facto API of the reference and are
+kept verbatim.  New device type ``tpu`` joins ``cpu``/``gpu`` (the whole point
+of this framework); unknown parameters raise, as in
+``ParameterAlias::KeyAliasTransform`` (config.h:479).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .log import Log
+
+# alias -> canonical   (config.h:362-450)
+ALIAS_TABLE: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "random_seed": "seed",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+}
+
+# canonical parameters accepted without aliasing (config.h:451-478), plus the
+# handful the reference reads outside the set (task/device/metric aliases) and
+# tpu-specific additions.
+PARAMETER_SET = {
+    "config", "config_file", "task", "device", "device_type",
+    "num_threads", "seed", "boosting_type", "objective", "data",
+    "output_model", "input_model", "output_result", "valid_data",
+    "is_enable_sparse", "is_pre_partition", "is_training_metric",
+    "ndcg_eval_at", "min_data_in_leaf", "min_sum_hessian_in_leaf",
+    "num_leaves", "feature_fraction", "num_iterations",
+    "bagging_fraction", "bagging_freq", "learning_rate", "tree_learner",
+    "num_machines", "local_listen_port", "use_two_round_loading",
+    "machine_list_file", "is_save_binary_file", "early_stopping_round",
+    "verbose", "has_header", "label_column", "weight_column", "group_column",
+    "ignore_column", "categorical_column", "is_predict_raw_score",
+    "is_predict_leaf_index", "min_gain_to_split", "top_k",
+    "lambda_l1", "lambda_l2", "num_class", "is_unbalance",
+    "max_depth", "subsample_for_bin", "max_bin", "bagging_seed",
+    "drop_rate", "skip_drop", "max_drop", "uniform_drop",
+    "xgboost_dart_mode", "drop_seed", "top_rate", "other_rate",
+    "min_data_in_bin", "data_random_seed", "bin_construct_sample_cnt",
+    "num_iteration_predict", "pred_early_stop", "pred_early_stop_freq",
+    "pred_early_stop_margin", "use_missing", "sigmoid", "huber_delta",
+    "fair_c", "poission_max_delta_step", "scale_pos_weight",
+    "boost_from_average", "max_position", "label_gain",
+    "metric", "metric_freq", "time_out",
+    "gpu_platform_id", "gpu_device_id", "gpu_use_dp",
+    "convert_model", "convert_model_language",
+    "feature_fraction_seed", "enable_bundle", "data_filename",
+    "valid_data_filenames", "snapshot_freq", "sparse_threshold",
+    "enable_load_from_binary_file", "max_conflict_rate",
+    "poisson_max_delta_step", "gaussian_eta", "histogram_pool_size",
+    "output_freq", "is_provide_training_metric", "machine_list_filename",
+    "capacity",
+    # tpu-native additions
+    "tpu_use_dp", "tpu_histogram_mode", "feature_name",
+}
+
+_TRUE_SET = {"1", "true", "yes", "on", "+"}
+_FALSE_SET = {"0", "false", "no", "off", "-"}
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in _TRUE_SET:
+        return True
+    if s in _FALSE_SET:
+        return False
+    Log.fatal("Parameter: value %s cannot be parsed as bool", v)
+
+
+def _to_int(v: Any) -> int:
+    if isinstance(v, bool):
+        return int(v)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return int(float(v))
+
+
+def _to_double_vec(v: Any) -> List[float]:
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [float(x) for x in s.replace(",", " ").split()]
+
+
+def _to_int_vec(v: Any) -> List[int]:
+    return [int(round(x)) for x in _to_double_vec(v)]
+
+
+def param_dict_to_str(data: Optional[dict]) -> str:
+    """Serialize params the way python-package/basic.py:124 does."""
+    if not data:
+        return ""
+    pairs = []
+    for key, val in data.items():
+        if isinstance(val, (list, tuple, set)):
+            pairs.append(str(key) + "=" + ",".join(map(str, val)))
+        elif isinstance(val, (str, int, float, bool)):
+            pairs.append(str(key) + "=" + str(val))
+        elif val is not None:
+            Log.fatal("Unknown type of parameter:%s, got:%s", key, type(val).__name__)
+    return " ".join(pairs)
+
+
+def key_alias_transform(params: Dict[str, Any], raise_unknown: bool = False) -> Dict[str, Any]:
+    """Canonicalise keys via the alias table (config.h:479-489 semantics).
+
+    A canonical key present in the input wins over any alias of it.  Unknown
+    keys are warned about (the CLI path raises, matching ``Log::Fatal``).
+    """
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for key, val in params.items():
+        if key in ALIAS_TABLE:
+            aliased.setdefault(ALIAS_TABLE[key], val)
+        else:
+            if key not in PARAMETER_SET:
+                if raise_unknown:
+                    Log.fatal("Unknown parameter: %s", key)
+                Log.warning("Unknown parameter: %s", key)
+            out[key] = val
+    for key, val in aliased.items():
+        out.setdefault(key, val)
+    return out
+
+
+class Config:
+    """Typed view over a canonical parameter dict.
+
+    Flat rather than the reference's nested sub-config structs — every field
+    of IOConfig/ObjectiveConfig/MetricConfig/TreeConfig/BoostingConfig/
+    NetworkConfig/OverallConfig (config.h:87-354) is present with the same
+    default.
+    """
+
+    _FIELDS = {
+        # OverallConfig
+        "task": ("str", "train"),
+        "seed": ("int", 0),
+        "num_threads": ("int", 0),
+        "boosting_type": ("str", "gbdt"),
+        "objective": ("str", "regression"),
+        "metric": ("strvec", None),            # resolved by boosting layer
+        "convert_model_language": ("str", ""),
+        # IOConfig
+        "max_bin": ("int", 255),
+        "num_class": ("int", 1),
+        "data_random_seed": ("int", 1),
+        "data": ("str", ""),
+        "valid_data": ("strvec", None),
+        "snapshot_freq": ("int", 100),
+        "output_model": ("str", "LightGBM_model.txt"),
+        "output_result": ("str", "LightGBM_predict_result.txt"),
+        "convert_model": ("str", "gbdt_prediction.cpp"),
+        "input_model": ("str", ""),
+        "verbose": ("int", 1),
+        "num_iteration_predict": ("int", -1),
+        "is_pre_partition": ("bool", False),
+        "is_enable_sparse": ("bool", True),
+        "sparse_threshold": ("float", 0.8),
+        "use_two_round_loading": ("bool", False),
+        "is_save_binary_file": ("bool", False),
+        "enable_load_from_binary_file": ("bool", True),
+        "bin_construct_sample_cnt": ("int", 200000),
+        "is_predict_leaf_index": ("bool", False),
+        "is_predict_raw_score": ("bool", False),
+        "min_data_in_leaf": ("int", 20),
+        "min_data_in_bin": ("int", 5),
+        "max_conflict_rate": ("float", 0.0),
+        "enable_bundle": ("bool", True),
+        "has_header": ("bool", False),
+        "label_column": ("str", ""),
+        "weight_column": ("str", ""),
+        "group_column": ("str", ""),
+        "ignore_column": ("str", ""),
+        "categorical_column": ("str", ""),
+        "device_type": ("str", "tpu"),
+        "pred_early_stop": ("bool", False),
+        "pred_early_stop_freq": ("int", 10),
+        "pred_early_stop_margin": ("float", 10.0),
+        # ObjectiveConfig
+        "sigmoid": ("float", 1.0),
+        "huber_delta": ("float", 1.0),
+        "fair_c": ("float", 1.0),
+        "gaussian_eta": ("float", 1.0),
+        "poisson_max_delta_step": ("float", 0.7),
+        "label_gain": ("floatvec", None),
+        "max_position": ("int", 20),
+        "is_unbalance": ("bool", False),
+        "scale_pos_weight": ("float", 1.0),
+        # MetricConfig
+        "ndcg_eval_at": ("intvec", None),
+        "metric_freq": ("int", 1),
+        # TreeConfig
+        "min_sum_hessian_in_leaf": ("float", 1e-3),
+        "lambda_l1": ("float", 0.0),
+        "lambda_l2": ("float", 0.0),
+        "min_gain_to_split": ("float", 0.0),
+        "num_leaves": ("int", 31),
+        "feature_fraction_seed": ("int", 2),
+        "feature_fraction": ("float", 1.0),
+        "histogram_pool_size": ("float", -1.0),
+        "max_depth": ("int", -1),
+        "top_k": ("int", 20),
+        "gpu_platform_id": ("int", -1),
+        "gpu_device_id": ("int", -1),
+        "gpu_use_dp": ("bool", False),
+        "use_missing": ("bool", True),
+        # BoostingConfig
+        "output_freq": ("int", 1),
+        "is_training_metric": ("bool", False),
+        "num_iterations": ("int", 100),
+        "learning_rate": ("float", 0.1),
+        "bagging_fraction": ("float", 1.0),
+        "bagging_seed": ("int", 3),
+        "bagging_freq": ("int", 0),
+        "early_stopping_round": ("int", 0),
+        "drop_rate": ("float", 0.1),
+        "max_drop": ("int", 50),
+        "skip_drop": ("float", 0.5),
+        "xgboost_dart_mode": ("bool", False),
+        "uniform_drop": ("bool", False),
+        "drop_seed": ("int", 4),
+        "top_rate": ("float", 0.2),
+        "other_rate": ("float", 0.1),
+        "capacity": ("float", 50.0),
+        "boost_from_average": ("bool", True),
+        "tree_learner": ("str", "serial"),
+        # NetworkConfig
+        "num_machines": ("int", 1),
+        "local_listen_port": ("int", 12400),
+        "time_out": ("int", 120),
+        "machine_list_file": ("str", ""),
+        # tpu-native additions
+        "tpu_use_dp": ("bool", False),
+        # 'auto' | 'scatter' | 'onehot' — histogram kernel selection
+        "tpu_histogram_mode": ("str", "auto"),
+    }
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 raise_unknown: bool = False):
+        params = dict(params or {})
+        params = key_alias_transform(params, raise_unknown=raise_unknown)
+        self.raw: Dict[str, Any] = params
+        for name, (kind, default) in self._FIELDS.items():
+            if name in params and params[name] is not None:
+                val = params[name]
+                if kind == "int":
+                    val = _to_int(val)
+                elif kind == "float":
+                    val = float(val)
+                elif kind == "bool":
+                    val = _to_bool(val)
+                elif kind == "str":
+                    val = str(val)
+                elif kind == "strvec":
+                    if isinstance(val, str):
+                        val = [s for s in val.replace(";", ",").split(",") if s]
+                    elif not isinstance(val, list):
+                        val = list(val)
+                    else:
+                        val = list(val)
+                elif kind == "floatvec":
+                    val = _to_double_vec(val)
+                elif kind == "intvec":
+                    val = _to_int_vec(val)
+            else:
+                val = default
+            setattr(self, name, val)
+        # alternate names that land in the same slot
+        if "machine_list_filename" in params:
+            self.machine_list_file = str(params["machine_list_filename"])
+        if "data_filename" in params:
+            self.data = str(params["data_filename"])
+        if "valid_data_filenames" in params and params["valid_data_filenames"]:
+            v = params["valid_data_filenames"]
+            self.valid_data = v if isinstance(v, list) else str(v).split(",")
+        if "is_provide_training_metric" in params:
+            self.is_training_metric = _to_bool(params["is_provide_training_metric"])
+        if "subsample_for_bin" in params:
+            self.bin_construct_sample_cnt = _to_int(params["subsample_for_bin"])
+        if "device" in params:
+            self.device_type = str(params["device"])
+        if "poission_max_delta_step" in params:  # reference's typo'd key
+            self.poisson_max_delta_step = float(params["poission_max_delta_step"])
+        self.check_param_conflict()
+
+    # --- semantics from OverallConfig::CheckParamConflict (src/io/config.cpp)
+    def check_param_conflict(self) -> None:
+        if self.num_leaves < 2:
+            Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if self.is_pre_partition and self.num_machines <= 1:
+            self.is_pre_partition = False
+        if self.max_depth > 0:
+            full = 1 << min(self.max_depth, 30)
+            if self.num_leaves > full:
+                self.num_leaves = full
+        obj = self.objective
+        if obj in ("multiclass", "multiclassova", "softmax") and self.num_class <= 1:
+            Log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+        if obj not in ("multiclass", "multiclassova", "softmax") and self.num_class != 1:
+            Log.fatal("Number of classes must be 1 for non-multiclass training")
+        Log.reset_level(self.verbose)
+
+    def metrics(self) -> List[str]:
+        """Resolve metric list; empty metric falls back to the objective's
+        default metric as the reference's GetMetricType does."""
+        if self.metric:
+            out = []
+            for m in self.metric:
+                m = m.strip()
+                if m and m not in out:
+                    out.append(m)
+            return [m for m in out if m not in ("None", "na", "null", "custom", "")]
+        default_map = {
+            "regression": "l2", "regression_l2": "l2", "mean_squared_error": "l2",
+            "mse": "l2", "regression_l1": "l1", "mean_absolute_error": "l1",
+            "mae": "l1", "huber": "huber", "fair": "fair", "poisson": "poisson",
+            "binary": "binary_logloss", "multiclass": "multi_logloss",
+            "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+            "lambdarank": "ndcg",
+        }
+        if self.objective in default_map:
+            return [default_map[self.objective]]
+        return []
+
+    def copy_with(self, **overrides) -> "Config":
+        new_raw = dict(self.raw)
+        new_raw.update(overrides)
+        return Config(new_raw)
+
+    def __repr__(self) -> str:
+        return "Config(%s)" % (self.raw,)

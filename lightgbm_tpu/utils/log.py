@@ -1,0 +1,49 @@
+"""Leveled logger mirroring the reference's static ``Log`` class.
+
+Reference: include/LightGBM/utils/log.h:38 (Debug/Info/Warning/Fatal with a
+``verbosity`` mapping in src/io/config.cpp).  ``Log.fatal`` raises instead of
+aborting the process so library users can catch errors.
+"""
+from __future__ import annotations
+
+import sys
+
+
+class LightGBMError(Exception):
+    """Error raised by lightgbm_tpu routines (mirrors ``Log::Fatal``)."""
+
+
+class Log:
+    # verbosity semantics match the reference: <0 fatal-only, 0 +warning,
+    # 1 +info (default), >1 +debug   (src/io/config.cpp verbosity mapping)
+    _level = 1
+
+    @classmethod
+    def reset_level(cls, verbosity: int) -> None:
+        cls._level = verbosity
+
+    @classmethod
+    def debug(cls, msg: str, *args) -> None:
+        if cls._level > 1:
+            cls._write("Debug", msg, args)
+
+    @classmethod
+    def info(cls, msg: str, *args) -> None:
+        if cls._level >= 1:
+            cls._write("Info", msg, args)
+
+    @classmethod
+    def warning(cls, msg: str, *args) -> None:
+        if cls._level >= 0:
+            cls._write("Warning", msg, args)
+
+    @classmethod
+    def fatal(cls, msg: str, *args) -> None:
+        text = (msg % args) if args else msg
+        raise LightGBMError(text)
+
+    @staticmethod
+    def _write(level: str, msg: str, args) -> None:
+        text = (msg % args) if args else msg
+        sys.stderr.write("[LightGBM-TPU] [%s] %s\n" % (level, text))
+        sys.stderr.flush()

@@ -1,0 +1,59 @@
+"""Deterministic per-seed RNG with the reference's sequence semantics.
+
+Reference: include/LightGBM/utils/random.h:14-112 — an LCG (x = 214013*x +
+2531011) with 15/31-bit extraction and a two-regime ``Sample(N, K)``
+(Bernoulli sweep when K > N/2, random-stride jump otherwise).  Reproducing the
+exact integer sequence keeps feature_fraction / bagging subsets identical to
+the reference for a given seed, which matters for convergence-parity tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Random:
+    def __init__(self, seed: int = 123456789):
+        self.x = np.uint32(seed)
+
+    def _next(self) -> np.uint32:
+        self.x = np.uint32(214013) * self.x + np.uint32(2531011)
+        return self.x
+
+    def next_short(self, lower: int, upper: int) -> int:
+        """Random int in [lower, upper) from the 15-bit extraction."""
+        r = int((int(self._next()) >> 16) & 0x7FFF)
+        return r % (upper - lower) + lower
+
+    def next_int(self, lower: int, upper: int) -> int:
+        r = int(self._next()) & 0x7FFFFFFF
+        return r % (upper - lower) + lower
+
+    def next_float(self) -> float:
+        r = int((int(self._next()) >> 16) & 0x7FFF)
+        return r / 32768.0
+
+    def sample(self, n: int, k: int) -> np.ndarray:
+        """K ordered samples from {0..N-1}; sequence-identical to
+        ``Random::Sample`` (random.h:65-95)."""
+        ret = []
+        if k > n or k < 0:
+            return np.asarray(ret, dtype=np.int32)
+        if k == n:
+            return np.arange(n, dtype=np.int32)
+        if k > n // 2:
+            for i in range(n):
+                prob = (k - len(ret)) / float(n - i)
+                if self.next_float() < prob:
+                    ret.append(i)
+        else:
+            min_step = 1
+            avg_step = n // k
+            max_step = 2 * avg_step - min_step
+            start = -1
+            for _ in range(k):
+                step = self.next_short(min_step, max_step + 1)
+                start += step
+                if start >= n:
+                    break
+                ret.append(start)
+        return np.asarray(ret, dtype=np.int32)
