@@ -1,0 +1,233 @@
+"""Device-resident leaf-wise tree growth — ONE dispatch per tree.
+
+The reference drives the leaf loop from the host (SerialTreeLearner::Train,
+serial_tree_learner.cpp:168-223), which is fine at C++ latencies but fatal
+when the accelerator sits behind a link with ~100ms round-trips.  Here the
+entire grow loop is a `lax.while_loop` inside one jitted program:
+
+  carry: (step, done, leaf_id, per-leaf histogram cache, per-leaf packed
+          best splits, per-leaf sums/depths, flat tree arrays)
+  body:  pick best leaf (argmax over packed gains) -> apply split to the
+         row->leaf map -> smaller child histogram by masked scan, larger by
+         parent-subtraction (feature_histogram.hpp:63-69) -> best-split scan
+         for both children.
+
+Tree arrays come back as a device pytree; the host materializes a
+models.Tree from them once per tree (real-valued thresholds resolved on host
+in float64 from the BinMappers).  Under a data-parallel mesh the same
+program shard_maps with a psum around the histogram — the reference's
+ReduceScatter path (data_parallel_tree_learner.cpp:148-222).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import leaf_histogram_onehot, leaf_histogram_scatter
+from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
+                           LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
+                           RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
+                           SPLIT_VEC_SIZE, THRESHOLD, FeatureMeta, SplitParams,
+                           find_best_split_impl)
+
+
+class TreeArrays(NamedTuple):
+    """Flat SoA tree mirroring tree.h:195-229, device-resident."""
+    num_leaves: jnp.ndarray          # scalar i32
+    split_feature: jnp.ndarray       # (L-1,) i32 inner feature index
+    threshold_bin: jnp.ndarray       # (L-1,) i32
+    default_bin_for_zero: jnp.ndarray  # (L-1,) i32
+    default_bin: jnp.ndarray         # (L-1,) i32 (feature's zero bin)
+    is_cat: jnp.ndarray              # (L-1,) i32
+    left_child: jnp.ndarray          # (L-1,) i32 (~leaf for leaves)
+    right_child: jnp.ndarray         # (L-1,) i32
+    split_gain: jnp.ndarray          # (L-1,) f
+    internal_value: jnp.ndarray      # (L-1,) f
+    internal_count: jnp.ndarray      # (L-1,) i32
+    leaf_parent: jnp.ndarray         # (L,) i32
+    leaf_value: jnp.ndarray          # (L,) f  (unshrunk outputs)
+    leaf_count: jnp.ndarray          # (L,) i32
+    leaf_depth: jnp.ndarray          # (L,) i32
+
+
+def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
+                 params: SplitParams, max_depth: int,
+                 hist_mode: str = "scatter", hist_dtype=jnp.float32,
+                 psum_axis: str = None):
+    """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
+
+    psum_axis: when set, histograms and scalar sums are psum'd over that
+    mesh axis (data-parallel training under shard_map).
+    """
+    L = num_leaves
+
+    if hist_mode == "onehot":
+        hist_fn = functools.partial(leaf_histogram_onehot, num_bins=num_bins)
+    else:
+        hist_fn = functools.partial(leaf_histogram_scatter, num_bins=num_bins)
+
+    def maybe_psum(x):
+        if psum_axis is not None:
+            return lax.psum(x, psum_axis)
+        return x
+
+    def hist_of_leaf(X, g, h, leaf_id, leaf, row_mult):
+        return maybe_psum(hist_fn(X, g, h, leaf_id, leaf, row_mult))
+
+    def best_of(hist, sums, feature_mask, depth):
+        b = find_best_split_impl(hist, sums[0], sums[1], sums[2], meta,
+                                 feature_mask, params)
+        if max_depth > 0:
+            b = b.at[GAIN].set(jnp.where(depth < max_depth, b[GAIN], -jnp.inf))
+        return b
+
+    def grow(X, grad, hess, row_mult, feature_mask):
+        n = X.shape[0]
+        grad = grad.astype(hist_dtype)
+        hess = hess.astype(hist_dtype)
+        row_mult = row_mult.astype(hist_dtype)
+        leaf_id = jnp.zeros(n, dtype=jnp.int32)
+
+        root_sums = maybe_psum(jnp.stack([
+            jnp.sum(grad * row_mult), jnp.sum(hess * row_mult),
+            jnp.sum(row_mult)]))
+        hist0 = hist_of_leaf(X, grad, hess, leaf_id, 0, row_mult)
+
+        F = hist0.shape[0]
+        B = hist0.shape[1]
+        hists = jnp.zeros((L, F, B, 3), dtype=hist_dtype).at[0].set(hist0)
+        bests = jnp.full((L, SPLIT_VEC_SIZE), -jnp.inf, dtype=hist_dtype)
+        bests = bests.at[0].set(best_of(hist0, root_sums, feature_mask, 0))
+        sums = jnp.zeros((L, 3), dtype=hist_dtype).at[0].set(root_sums)
+
+        tree = TreeArrays(
+            num_leaves=jnp.asarray(1, jnp.int32),
+            split_feature=jnp.zeros(L - 1, jnp.int32),
+            threshold_bin=jnp.zeros(L - 1, jnp.int32),
+            default_bin_for_zero=jnp.zeros(L - 1, jnp.int32),
+            default_bin=jnp.zeros(L - 1, jnp.int32),
+            is_cat=jnp.zeros(L - 1, jnp.int32),
+            left_child=jnp.zeros(L - 1, jnp.int32),
+            right_child=jnp.zeros(L - 1, jnp.int32),
+            split_gain=jnp.zeros(L - 1, hist_dtype),
+            internal_value=jnp.zeros(L - 1, hist_dtype),
+            internal_count=jnp.zeros(L - 1, jnp.int32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_value=jnp.zeros(L, hist_dtype),
+            leaf_count=jnp.zeros(L, jnp.int32).at[0].set(
+                root_sums[2].astype(jnp.int32)),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+        )
+
+        def cond(carry):
+            step, done = carry[0], carry[1]
+            return (step < L - 1) & ~done
+
+        def body(carry):
+            step, done, leaf_id, hists, bests, sums, tree = carry
+            gains = bests[:, GAIN]
+            best_leaf = jnp.argmax(gains).astype(jnp.int32)
+            info = bests[best_leaf]
+            ok = info[GAIN] > 0.0     # SerialTreeLearner::Train:203-207
+
+            node = step                       # new internal node index
+            new_leaf = step + 1               # right child leaf index
+            f = info[FEATURE].astype(jnp.int32)
+            thr = info[THRESHOLD].astype(jnp.int32)
+            dbz = info[DEFAULT_BIN_FOR_ZERO].astype(jnp.int32)
+            cat = info[IS_CAT] > 0.5
+            fdefault = meta.default_bin[f]
+            default_left = jnp.where(cat, dbz == thr, dbz <= thr)
+
+            # ---- partition (dense_bin.hpp:190-222 semantics)
+            col = jnp.take(X, f, axis=1).astype(jnp.int32)
+            in_leaf = leaf_id == best_leaf
+            go_left = jnp.where(cat, col == thr, col <= thr)
+            go_left = jnp.where(col == fdefault, default_left, go_left)
+            new_leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, leaf_id)
+            leaf_id = jnp.where(ok, new_leaf_id, leaf_id)
+
+            # ---- tree bookkeeping (tree.cpp:55-110)
+            parent = tree.leaf_parent[best_leaf]
+            # fix the grandparent's child pointer
+            lc = tree.left_child
+            rc = tree.right_child
+            was_left = lc[jnp.maximum(parent, 0)] == ~best_leaf
+            lc = lc.at[jnp.maximum(parent, 0)].set(
+                jnp.where(ok & (parent >= 0) & was_left, node,
+                          lc[jnp.maximum(parent, 0)]))
+            rc = rc.at[jnp.maximum(parent, 0)].set(
+                jnp.where(ok & (parent >= 0) & ~was_left, node,
+                          rc[jnp.maximum(parent, 0)]))
+            lc = lc.at[node].set(jnp.where(ok, ~best_leaf, lc[node]))
+            rc = rc.at[node].set(jnp.where(ok, ~new_leaf, rc[node]))
+
+            depth = tree.leaf_depth[best_leaf] + 1
+            upd = lambda arr, idx, val: arr.at[idx].set(
+                jnp.where(ok, val, arr[idx]))
+            tree = tree._replace(
+                num_leaves=tree.num_leaves + ok.astype(jnp.int32),
+                split_feature=upd(tree.split_feature, node, f),
+                threshold_bin=upd(tree.threshold_bin, node, thr),
+                default_bin_for_zero=upd(tree.default_bin_for_zero, node, dbz),
+                default_bin=upd(tree.default_bin, node, fdefault),
+                is_cat=upd(tree.is_cat, node, cat.astype(jnp.int32)),
+                left_child=lc,
+                right_child=rc,
+                split_gain=upd(tree.split_gain, node, info[GAIN]),
+                internal_value=upd(tree.internal_value, node,
+                                   tree.leaf_value[best_leaf]),
+                internal_count=upd(tree.internal_count, node,
+                                   (info[LEFT_COUNT] + info[RIGHT_COUNT])
+                                   .astype(jnp.int32)),
+                leaf_parent=upd(upd(tree.leaf_parent, best_leaf, node),
+                                new_leaf, jnp.where(ok, node, -1)),
+                leaf_value=upd(upd(tree.leaf_value, best_leaf,
+                                   info[LEFT_OUTPUT]),
+                               new_leaf, info[RIGHT_OUTPUT]),
+                leaf_count=upd(upd(tree.leaf_count, best_leaf,
+                                   info[LEFT_COUNT].astype(jnp.int32)),
+                               new_leaf, info[RIGHT_COUNT].astype(jnp.int32)),
+                leaf_depth=upd(upd(tree.leaf_depth, best_leaf, depth),
+                               new_leaf, depth),
+            )
+
+            # ---- children: smaller scanned, larger by subtraction
+            left_sums = jnp.stack([info[LEFT_SUM_G], info[LEFT_SUM_H],
+                                   info[LEFT_COUNT]])
+            right_sums = jnp.stack([info[RIGHT_SUM_G], info[RIGHT_SUM_H],
+                                    info[RIGHT_COUNT]])
+            left_smaller = info[LEFT_COUNT] < info[RIGHT_COUNT]
+            small = jnp.where(left_smaller, best_leaf, new_leaf)
+            large = jnp.where(left_smaller, new_leaf, best_leaf)
+            small_sums = jnp.where(left_smaller, left_sums, right_sums)
+            large_sums = jnp.where(left_smaller, right_sums, left_sums)
+
+            hist_small = hist_of_leaf(X, grad, hess, leaf_id, small, row_mult)
+            hist_large = hists[best_leaf] - hist_small
+            hists = hists.at[small].set(jnp.where(ok, hist_small, hists[small]))
+            hists = hists.at[large].set(jnp.where(ok, hist_large, hists[large]))
+            sums = sums.at[small].set(jnp.where(ok, small_sums, sums[small]))
+            sums = sums.at[large].set(jnp.where(ok, large_sums, sums[large]))
+
+            best_small = best_of(hist_small, small_sums, feature_mask, depth)
+            best_large = best_of(hist_large, large_sums, feature_mask, depth)
+            neg = jnp.full((SPLIT_VEC_SIZE,), -jnp.inf, bests.dtype)
+            bests = bests.at[best_leaf].set(neg)   # consumed
+            bests = bests.at[small].set(jnp.where(ok, best_small, bests[small]))
+            bests = bests.at[large].set(jnp.where(ok, best_large, bests[large]))
+
+            return (step + ok.astype(jnp.int32), ~ok, leaf_id, hists, bests,
+                    sums, tree)
+
+        carry = (jnp.asarray(0, jnp.int32), jnp.asarray(False), leaf_id,
+                 hists, bests, sums, tree)
+        carry = lax.while_loop(cond, body, carry)
+        _, _, leaf_id, _, _, _, tree = carry
+        return tree, leaf_id
+
+    return grow

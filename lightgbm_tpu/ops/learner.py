@@ -1,29 +1,20 @@
-"""Leaf-wise (best-first) tree learner driving the XLA ops.
+"""Tree learner: host facade over the device-resident grower (ops/grow.py).
 
-Parity target: src/treelearner/serial_tree_learner.cpp:168-223 — the same
-grow loop (root sums -> repeat: construct smaller-leaf histogram, derive the
-larger leaf by subtraction (feature_histogram.hpp:63-69), best-split scan,
-split the winning leaf) with the device doing all O(N) work:
+Replaces SerialTreeLearner (serial_tree_learner.cpp) with a single jitted
+XLA program per tree; the host only samples feature_fraction masks, feeds
+gradients, and materializes the finished tree.  `train_device` returns the
+device pytree without any host sync — the GBDT loop uses it to keep the
+whole boosting iteration on-device; `train` additionally materializes a
+models.Tree (real-valued thresholds resolved in float64 via the BinMappers).
 
-* histograms: ops.histogram.leaf_histogram (masked scatter / one-hot matmul);
-* split search: ops.split_finder.find_best_split (whole-histogram scan);
-* partition: ops.partition.apply_split (masked leaf_id rewrite).
-
-The host keeps only the tiny per-leaf bookkeeping (sums, gains, tree arrays),
-mirroring how the GPU learner kept control flow on CPU
-(gpu_tree_learner.cpp:977-1072).  Under data-parallel sharding the same code
-runs unchanged: the histogram reduction becomes a psum across the row-sharded
-mesh (see parallel/mesh.py), which is the reference's ReduceScatter path
-(data_parallel_tree_learner.cpp:148-222) collapsed into XLA collectives.
-
-Bagging and GOSS enter through ``row_mult`` — a per-row multiplier folded
-into histogram weights, replacing bag-index re-partitioning
-(gbdt.cpp:265-324).
+Bagging/GOSS enter via `row_mult`; data-parallel runs wrap the same grow
+program in shard_map (parallel/mesh.py).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -31,155 +22,84 @@ from ..io.dataset import TrainingData
 from ..models.tree import Tree
 from ..utils.config import Config
 from ..utils.random import Random
-from .histogram import leaf_histogram, leaf_sums
-from .partition import apply_split
-from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
-                           LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
-                           RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
-                           THRESHOLD, FeatureMeta, SplitParams, find_best_split)
+from .grow import TreeArrays, make_grow_fn
+from .split_finder import FeatureMeta, SplitParams
+
+
+def build_split_params(config: Config) -> SplitParams:
+    return SplitParams(
+        lambda_l1=float(config.lambda_l1),
+        lambda_l2=float(config.lambda_l2),
+        min_gain_to_split=float(config.min_gain_to_split),
+        min_data_in_leaf=float(config.min_data_in_leaf),
+        min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+        use_missing=bool(config.use_missing),
+    )
 
 
 class SerialTreeLearner:
-    """One tree per call; reused across iterations (TreeLearner::Train)."""
-
-    def __init__(self, config: Config, train_data: TrainingData):
+    def __init__(self, config: Config, train_data: TrainingData,
+                 psum_axis: Optional[str] = None, device_data=None):
         self.config = config
         self.train_data = train_data
         self.num_leaves = config.num_leaves
-        self.max_depth = config.max_depth
         self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
         self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
-        self.X = jnp.asarray(train_data.binned)
+        self.X = device_data if device_data is not None else jnp.asarray(train_data.binned)
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(train_data.num_bin_arr),
             default_bin=jnp.asarray(train_data.default_bin_arr),
             is_categorical=jnp.asarray(train_data.is_categorical_arr),
         )
-        self.params = SplitParams(
-            lambda_l1=float(config.lambda_l1),
-            lambda_l2=float(config.lambda_l2),
-            min_gain_to_split=float(config.min_gain_to_split),
-            min_data_in_leaf=float(config.min_data_in_leaf),
-            min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
-            use_missing=bool(config.use_missing),
-        )
-        self.hist_mode = config.tpu_histogram_mode
+        self.params = build_split_params(config)
+        hist_mode = config.tpu_histogram_mode
+        if hist_mode == "auto":
+            hist_mode = ("onehot" if jax.default_backend() == "tpu"
+                         and self.num_bins <= 64 else "scatter")
+        grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
+                            self.params, config.max_depth,
+                            hist_mode=hist_mode, hist_dtype=self.dtype,
+                            psum_axis=psum_axis)
+        self._grow = jax.jit(grow) if psum_axis is None else grow
+        self._ones = jnp.ones(train_data.num_data, self.dtype)
+        self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
         # feature_fraction RNG persists across trees
         # (serial_tree_learner.cpp:40-96 Init + :257-275 BeforeTrain)
         self._feature_rng = Random(config.feature_fraction_seed)
-        self.leaf_id: Optional[jnp.ndarray] = None
 
     # ------------------------------------------------------------ internals
-    def _sample_features(self) -> np.ndarray:
+    def sample_feature_mask(self):
         f = self.train_data.num_features
-        mask = np.ones(f, dtype=bool)
-        if self.config.feature_fraction < 1.0:
-            used_cnt = int(f * self.config.feature_fraction)
-            idx = self._feature_rng.sample(f, used_cnt)
-            mask[:] = False
-            mask[idx] = True
-        return mask
-
-    def _depth_ok(self, depth: int) -> bool:
-        return self.max_depth <= 0 or depth < self.max_depth
+        if self.config.feature_fraction >= 1.0 or f == 0:
+            return self._full_mask
+        used_cnt = int(f * self.config.feature_fraction)
+        idx = self._feature_rng.sample(f, used_cnt)
+        mask = np.zeros(f, dtype=bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
 
     # ----------------------------------------------------------------- train
-    def train(self, grad, hess, row_mult=None) -> Tuple[Tree, jnp.ndarray]:
-        """Grow one tree; returns (tree, final per-row leaf assignment)."""
-        td = self.train_data
-        n = td.num_data
+    def train_device(self, grad, hess, row_mult=None,
+                     feature_mask=None) -> Tuple[TreeArrays, jnp.ndarray]:
+        """Grow one tree fully on device; no host synchronization."""
+        if row_mult is None:
+            row_mult = self._ones
+        else:
+            row_mult = jnp.asarray(row_mult, self.dtype)
+        if feature_mask is None:
+            feature_mask = self.sample_feature_mask()
         grad = jnp.asarray(grad, self.dtype)
         hess = jnp.asarray(hess, self.dtype)
-        if row_mult is not None:
-            row_mult = jnp.asarray(row_mult, self.dtype)
-        feature_mask = jnp.asarray(self._sample_features())
+        return self._grow(self.X, grad, hess, row_mult, feature_mask)
 
-        leaf_id = jnp.zeros(n, dtype=jnp.int32)
-        tree = Tree(self.num_leaves)
-        if td.num_features == 0:
-            return tree, leaf_id
-
-        root = np.asarray(leaf_sums(grad, hess, leaf_id, 0, row_mult))
-        hists: Dict[int, jnp.ndarray] = {}
-        bests: Dict[int, np.ndarray] = {}
-        sums: Dict[int, Tuple[float, float, float]] = {0: tuple(root)}
-
-        hists[0] = leaf_histogram(self.X, grad, hess, leaf_id, 0, row_mult,
-                                  self.num_bins, self.hist_mode)
-        bests[0] = np.asarray(find_best_split(
-            hists[0], root[0], root[1], root[2], self.meta, feature_mask,
-            self.params))
-        if not self._depth_ok(0):
-            bests[0][GAIN] = -np.inf
-
-        for _ in range(self.num_leaves - 1):
-            # global best leaf (ArgMax over best_split_per_leaf_,
-            # serial_tree_learner.cpp:203)
-            best_leaf, best_gain = -1, 0.0
-            for leaf, b in bests.items():
-                if np.isfinite(b[GAIN]) and b[GAIN] > best_gain:
-                    best_leaf, best_gain = leaf, b[GAIN]
-            if best_leaf < 0:
-                break
-            info = bests.pop(best_leaf)
-            inner_f = int(info[FEATURE])
-            thr_bin = int(info[THRESHOLD])
-            dbz = int(info[DEFAULT_BIN_FOR_ZERO])
-            is_cat = bool(info[IS_CAT])
-            mapper = td.feature_bin_mapper(inner_f)
-            default_bin = mapper.default_bin
-            real_f = td.real_feature_index(inner_f)
-            # default_value only differs from 0 when the zero bin moved
-            # (serial_tree_learner.cpp:546-549)
-            default_value = 0.0
-            if default_bin != dbz:
-                default_value = td.real_threshold(inner_f, dbz)
-
-            right_leaf = tree.split(
-                best_leaf, inner_f, is_cat, thr_bin, real_f,
-                td.real_threshold(inner_f, thr_bin),
-                float(info[LEFT_OUTPUT]), float(info[RIGHT_OUTPUT]),
-                int(info[LEFT_COUNT]), int(info[RIGHT_COUNT]),
-                float(info[GAIN]), default_bin, dbz, default_value)
-
-            default_left = (dbz == thr_bin) if is_cat else (dbz <= thr_bin)
-            leaf_id = apply_split(self.X, leaf_id, best_leaf, inner_f, thr_bin,
-                                  default_bin, default_left, is_cat, right_leaf)
-
-            left_sums = (float(info[LEFT_SUM_G]), float(info[LEFT_SUM_H]),
-                         float(info[LEFT_COUNT]))
-            right_sums = (float(info[RIGHT_SUM_G]), float(info[RIGHT_SUM_H]),
-                          float(info[RIGHT_COUNT]))
-            sums[best_leaf] = left_sums
-            sums[right_leaf] = right_sums
-
-            if tree.num_leaves >= self.num_leaves:
-                break
-
-            # smaller child scanned, larger derived by subtraction
-            # (serial_tree_learner.cpp:452-534)
-            if info[LEFT_COUNT] < info[RIGHT_COUNT]:
-                small, large = best_leaf, right_leaf
-            else:
-                small, large = right_leaf, best_leaf
-            parent_hist = hists.pop(best_leaf)
-            hist_small = leaf_histogram(self.X, grad, hess, leaf_id, small,
-                                        row_mult, self.num_bins, self.hist_mode)
-            hist_large = parent_hist - hist_small
-            hists[small] = hist_small
-            hists[large] = hist_large
-
-            depth = tree.depth_of_leaf(best_leaf)
-            for child, hist in ((small, hist_small), (large, hist_large)):
-                sg, sh, sc = sums[child]
-                b = np.asarray(find_best_split(
-                    hist, sg, sh, sc, self.meta, feature_mask, self.params))
-                if not self._depth_ok(depth):
-                    b[GAIN] = -np.inf
-                bests[child] = b
-
-        self.leaf_id = leaf_id
+    def train(self, grad, hess, row_mult=None) -> Tuple[Tree, jnp.ndarray]:
+        dev_tree, leaf_id = self.train_device(grad, hess, row_mult)
+        tree = self.materialize(dev_tree)
         return tree, leaf_id
+
+    def materialize(self, dev_tree: TreeArrays) -> Tree:
+        return materialize_tree(jax.device_get(dev_tree), self.train_data,
+                                self.num_leaves)
 
     # ------------------------------------------------------------ DART refit
     def fit_by_existing_tree(self, tree: Tree, grad, hess) -> Tree:
@@ -220,3 +140,45 @@ class SerialTreeLearner:
             node[idx] = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
             active = node >= 0
         return (~node).astype(np.int32)
+
+
+def materialize_tree(host_tree: TreeArrays, train_data: TrainingData,
+                     max_leaves: int) -> Tree:
+    """Device tree arrays -> models.Tree with real-valued thresholds.
+
+    Real thresholds and default values are resolved host-side in float64
+    (Dataset::RealThreshold, dataset.h:457-462) so the text model format
+    keeps full precision.
+    """
+    nl = int(host_tree.num_leaves)
+    tree = Tree(max(max_leaves, 2))
+    tree.num_leaves = nl
+    if nl <= 1:
+        return tree
+    ni = nl - 1
+    tree.split_feature_inner[:ni] = host_tree.split_feature[:ni]
+    tree.threshold_in_bin[:ni] = host_tree.threshold_bin[:ni]
+    tree.default_bin_for_zero[:ni] = host_tree.default_bin_for_zero[:ni]
+    tree.zero_bin[:ni] = host_tree.default_bin[:ni]
+    tree.decision_type[:ni] = host_tree.is_cat[:ni].astype(np.int8)
+    tree.has_categorical = bool(host_tree.is_cat[:ni].any())
+    tree.left_child[:ni] = host_tree.left_child[:ni]
+    tree.right_child[:ni] = host_tree.right_child[:ni]
+    tree.split_gain[:ni] = host_tree.split_gain[:ni]
+    tree.internal_value[:ni] = host_tree.internal_value[:ni]
+    tree.internal_count[:ni] = host_tree.internal_count[:ni]
+    tree.leaf_parent[:nl] = host_tree.leaf_parent[:nl]
+    tree.leaf_value[:nl] = host_tree.leaf_value[:nl]
+    tree.leaf_count[:nl] = host_tree.leaf_count[:nl]
+    tree.leaf_depth[:nl] = host_tree.leaf_depth[:nl]
+    for i in range(ni):
+        inner_f = int(host_tree.split_feature[i])
+        mapper = train_data.feature_bin_mapper(inner_f)
+        tree.split_feature[i] = train_data.real_feature_index(inner_f)
+        tree.threshold[i] = mapper.bin_to_value(int(host_tree.threshold_bin[i]))
+        dbz = int(host_tree.default_bin_for_zero[i])
+        if dbz != mapper.default_bin:
+            tree.default_value[i] = mapper.bin_to_value(dbz)
+        else:
+            tree.default_value[i] = 0.0
+    return tree

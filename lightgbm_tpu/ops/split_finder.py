@@ -209,9 +209,8 @@ def _merge(best: _Cand, cand: _Cand) -> _Cand:
     return _Cand(*[jnp.where(take, cn, bn) for cn, bn in zip(cand, best)])
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def find_best_split(hist, total_g, total_h, total_cnt,
-                    meta: FeatureMeta, feature_mask, params: SplitParams):
+def find_best_split_impl(hist, total_g, total_h, total_cnt,
+                         meta: FeatureMeta, feature_mask, params: SplitParams):
     """Best split for one leaf.
 
     Args:
@@ -277,3 +276,11 @@ def find_best_split(hist, total_g, total_h, total_cnt,
     out = out.at[GAIN].set(jnp.where(jnp.isfinite(bgain),
                                      bgain - min_gain_shift, -jnp.inf))
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist, total_g, total_h, total_cnt,
+                    meta: FeatureMeta, feature_mask, params: SplitParams):
+    """Jitted standalone wrapper around find_best_split_impl."""
+    return find_best_split_impl(hist, total_g, total_h, total_cnt, meta,
+                                feature_mask, params)
