@@ -343,8 +343,10 @@ class Booster:
 
     def __inner_predict_raw(self, data_idx: int) -> np.ndarray:
         if data_idx == 0:
-            return self._gbdt._score_for_objective()
-        return self._gbdt.valid_score[data_idx - 1].reshape(-1)
+            raw = self._gbdt.train_score
+        else:
+            raw = self._gbdt.valid_score_host(data_idx - 1)
+        return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
@@ -402,7 +404,7 @@ class Booster:
 
     def __inner_predict_for_eval(self, data_idx: int) -> np.ndarray:
         raw = (self._gbdt.train_score if data_idx == 0
-               else self._gbdt.valid_score[data_idx - 1])
+               else self._gbdt.valid_score_host(data_idx - 1))
         return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
 
     # -------------------------------------------------------------- predict

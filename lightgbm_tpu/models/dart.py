@@ -3,6 +3,7 @@
 Drops a random subset of prior trees each iteration (uniform or
 weight-proportional), trains on the adjusted score, then re-normalizes the
 dropped trees — the lightgbm ``k/(k+1)`` scheme or ``xgboost_dart_mode``.
+Score adjustments run as device traversals of the dropped trees.
 
 Deviation from the reference: tree indices account for the
 boost_from_average stub tree (the reference indexes ``i * k + tid`` even when
@@ -11,8 +12,6 @@ models_[0] is the stub, dropping the wrong tree in that configuration).
 from __future__ import annotations
 
 from typing import List
-
-import numpy as np
 
 from ..utils.random import Random
 from .gbdt import GBDT
@@ -77,12 +76,14 @@ class DART(GBDT):
                 for i in range(self.iter):
                     if self.random_for_drop.next_float() < drop_rate:
                         self.drop_index.append(i)
+        if self.drop_index:
+            self._materialize()
         # remove dropped trees' contribution from the training score
         for i in self.drop_index:
             for tid in range(self.num_tree_per_iteration):
                 tree = self._tree_at(i, tid)
                 tree.shrink(-1.0)
-                self._add_tree_score(tree, self.train_data, self.train_score[tid])
+                self._apply_tree_to_train(tree, tid)
         k = float(len(self.drop_index))
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
@@ -101,16 +102,16 @@ class DART(GBDT):
                 tree = self._tree_at(i, tid)
                 if not cfg.xgboost_dart_mode:
                     tree.shrink(1.0 / (k + 1.0))
-                    for vd, vs in zip(self.valid_data, self.valid_score):
-                        self._add_tree_score(tree, vd, vs[tid])
+                    for vi in range(len(self.valid_data)):
+                        self._apply_tree_to_valid(tree, vi, tid)
                     tree.shrink(-k)
-                    self._add_tree_score(tree, self.train_data, self.train_score[tid])
+                    self._apply_tree_to_train(tree, tid)
                 else:
                     tree.shrink(self.shrinkage_rate)
-                    for vd, vs in zip(self.valid_data, self.valid_score):
-                        self._add_tree_score(tree, vd, vs[tid])
+                    for vi in range(len(self.valid_data)):
+                        self._apply_tree_to_valid(tree, vi, tid)
                     tree.shrink(-k / cfg.learning_rate)
-                    self._add_tree_score(tree, self.train_data, self.train_score[tid])
+                    self._apply_tree_to_train(tree, tid)
             if not cfg.uniform_drop:
                 if not cfg.xgboost_dart_mode:
                     self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))

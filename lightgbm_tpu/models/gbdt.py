@@ -16,20 +16,28 @@ Parity target: src/boosting/gbdt.cpp / gbdt.h.  Mirrored behaviors:
   surface shared with the reference line;
 * split-count feature importance (gbdt.cpp:973-997).
 
-Scores are kept as (num_tree_per_iteration, num_data) float64 — the
-reference's column-major flat array, reshaped.
+TPU-first design: train/valid scores are DEVICE arrays; a fast-path
+iteration (gradients -> grow tree -> partition score update -> valid
+traversal updates) is a handful of async XLA dispatches with **zero host
+round-trips** — essential because the accelerator may sit behind a
+high-latency link.  Host numpy mirrors are pulled lazily (metric eval,
+custom fobj) and trees are materialized lazily in one stacked transfer.
+Scores layout is the reference's column-major flat array, shaped
+(num_tree_per_iteration, num_data).
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..io.dataset import TrainingData
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction, load_objective_from_string
-from ..ops.learner import SerialTreeLearner
-from ..ops.partition import leaf_outputs_to_scores
+from ..ops.learner import SerialTreeLearner, materialize_tree
+from ..ops import predict as dev_predict
 from ..utils.config import Config
 from ..utils.common import parse_kv_lines
 from ..utils.log import Log
@@ -46,7 +54,11 @@ class GBDT:
                  objective: Optional[ObjectiveFunction] = None,
                  training_metrics: Sequence[Metric] = ()):
         self.config = config
-        self.models: List[Tree] = []
+        # models: host Trees; None entries are pending materialization from
+        # the aligned _models_dev/_models_shrink slots
+        self.models: List[Optional[Tree]] = []
+        self._models_dev: List[Optional[object]] = []
+        self._models_shrink: List[float] = []
         self.iter = 0
         self.num_init_iteration = 0
         self.boost_from_average_used = False
@@ -62,11 +74,15 @@ class GBDT:
         self.learner: Optional[SerialTreeLearner] = None
         self.training_metrics: List[Metric] = list(training_metrics)
         self.valid_data: List[TrainingData] = []
-        self.valid_score: List[np.ndarray] = []
         self.valid_metrics: List[List[Metric]] = []
+        self._valid_X_dev: List[jnp.ndarray] = []
+        self._valid_score_dev: List[jnp.ndarray] = []
+        self._valid_score_host: List[Optional[np.ndarray]] = []
         self.best_score: List[List[float]] = []
         self.best_iter: List[List[int]] = []
         self.best_msg: List[List[str]] = []
+        self._score_dev: Optional[jnp.ndarray] = None
+        self._score_host: Optional[np.ndarray] = None
         self.num_tree_per_iteration = 1
         if objective is not None:
             self.num_tree_per_iteration = objective.num_tree_per_iteration()
@@ -93,25 +109,28 @@ class GBDT:
         self.train_data = train_data
         self.num_data = train_data.num_data
         self.learner = SerialTreeLearner(config, train_data)
+        self.score_dtype = self.learner.dtype
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
         self.feature_infos = train_data.feature_infos()
 
         k = self.num_tree_per_iteration
-        self.train_score = np.zeros((k, self.num_data), dtype=np.float64)
         init = train_data.metadata.init_score
         self.has_init_score = init is not None
+        score0 = np.zeros((k, self.num_data), dtype=np.float64)
         if self.has_init_score:
             if len(init) % self.num_data != 0 or len(init) // self.num_data != k:
                 Log.fatal("number of class for initial score error")
-            self.train_score[:] = np.asarray(init).reshape(k, self.num_data)
+            score0[:] = np.asarray(init).reshape(k, self.num_data)
+        self._score_dev = jnp.asarray(score0, self.score_dtype)
+        self._score_host = None
         # re-apply existing models on (possibly new) training data
+        self._materialize()
         for i in range(self.iter):
             for tid in range(k):
                 t = (i + self.num_init_iteration) * k + tid
-                self._add_tree_score(self.models[t], train_data,
-                                     self.train_score[tid])
+                self._apply_tree_to_train(self.models[t], tid)
 
         # degenerate class handling (gbdt.cpp:166-205)
         self.class_need_train = [True] * k
@@ -138,7 +157,7 @@ class GBDT:
 
         # bagging state (gbdt.cpp ResetBaggingConfig, :134-160)
         self.bag_data_cnt = self.num_data
-        self.row_mult: Optional[np.ndarray] = None
+        self.row_mult: Optional[jnp.ndarray] = None
         if config.bagging_fraction < 1.0 and config.bagging_freq > 0:
             self.bag_data_cnt = int(config.bagging_fraction * self.num_data)
 
@@ -150,16 +169,109 @@ class GBDT:
         init = valid_data.metadata.init_score
         if init is not None:
             score[:] = np.asarray(init).reshape(k, valid_data.num_data)
-        # apply existing models
-        for t, tree in enumerate(self.models):
-            tid = t % k
-            self._add_tree_score(tree, valid_data, score[tid])
+        Xv = jnp.asarray(valid_data.binned)
+        score_dev = jnp.asarray(score, self.score_dtype)
         self.valid_data.append(valid_data)
-        self.valid_score.append(score)
+        self._valid_X_dev.append(Xv)
+        self._valid_score_dev.append(score_dev)
+        self._valid_score_host.append(None)
+        vi = len(self.valid_data) - 1
+        # apply existing models
+        self._materialize()
+        for t, tree in enumerate(self.models):
+            self._apply_tree_to_valid(tree, vi, t % k)
         self.valid_metrics.append(list(valid_metrics))
         self.best_score.append([-np.inf] * len(valid_metrics))
         self.best_iter.append([0] * len(valid_metrics))
         self.best_msg.append([""] * len(valid_metrics))
+
+    # ------------------------------------------------------ score management
+    @property
+    def train_score(self) -> np.ndarray:
+        """Host mirror of the training scores (pull-on-demand)."""
+        if self._score_host is None:
+            self._score_host = np.asarray(self._score_dev, dtype=np.float64)
+        return self._score_host
+
+    def valid_score_host(self, i: int) -> np.ndarray:
+        if self._valid_score_host[i] is None:
+            self._valid_score_host[i] = np.asarray(self._valid_score_dev[i],
+                                                   dtype=np.float64)
+        return self._valid_score_host[i]
+
+    def _invalidate_train(self):
+        self._score_host = None
+
+    def _invalidate_valid(self, i: int):
+        self._valid_score_host[i] = None
+
+    def _apply_tree_to_train(self, tree: Tree, tid: int, scale: float = 1.0):
+        """Add a host tree's prediction to the train score (device traversal
+        when bin thresholds exist, raw-data fallback for loaded models)."""
+        if tree.num_leaves <= 1:
+            return
+        if tree.has_bin_thresholds:
+            ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
+            self._score_dev = self._score_dev.at[tid].set(
+                dev_predict.add_tree_to_score(self._score_dev[tid],
+                                              self.learner.X, ta,
+                                              jnp.asarray(scale, self.score_dtype)))
+        elif self.train_data.raw_data is not None:
+            s = self.train_score
+            s[tid] += scale * tree.predict(self.train_data.raw_data)
+            self._score_dev = self._score_dev.at[tid].set(
+                jnp.asarray(s[tid], self.score_dtype))
+        else:
+            Log.fatal("Cannot apply a loaded model to binned-only data; "
+                      "keep raw data when continuing training")
+        self._invalidate_train()
+
+    def _apply_tree_to_valid(self, tree: Tree, vi: int, tid: int,
+                             scale: float = 1.0):
+        if tree.num_leaves <= 1:
+            return
+        if tree.has_bin_thresholds:
+            ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
+            self._valid_score_dev[vi] = self._valid_score_dev[vi].at[tid].set(
+                dev_predict.add_tree_to_score(self._valid_score_dev[vi][tid],
+                                              self._valid_X_dev[vi], ta,
+                                              jnp.asarray(scale, self.score_dtype)))
+        elif self.valid_data[vi].raw_data is not None:
+            s = self.valid_score_host(vi)
+            s[tid] += scale * tree.predict(self.valid_data[vi].raw_data)
+            self._valid_score_dev[vi] = self._valid_score_dev[vi].at[tid].set(
+                jnp.asarray(s[tid], self.score_dtype))
+        else:
+            Log.fatal("Validation data lacks both bin thresholds and raw data")
+        self._invalidate_valid(vi)
+
+    # ---------------------------------------------------- model realization
+    def _materialize(self) -> None:
+        """Materialize all pending device trees into host Trees (one stacked
+        device->host transfer for the whole batch)."""
+        pending = [i for i, m in enumerate(self.models) if m is None]
+        if not pending:
+            return
+        devs = [self._models_dev[i] for i in pending]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *devs) \
+            if len(devs) > 1 else devs[0]
+        host = jax.device_get(stacked)
+        for j, i in enumerate(pending):
+            ht = jax.tree_util.tree_map(lambda x: x[j], host) \
+                if len(devs) > 1 else host
+            tree = materialize_tree(ht, self.train_data,
+                                    self.config.num_leaves)
+            tree.shrink(self._models_shrink[i])
+            self.models[i] = tree
+            self._models_dev[i] = None
+        # release device buffers
+        self._models_shrink = [0.0 if m is not None else s
+                               for m, s in zip(self.models, self._models_shrink)]
+
+    def _append_host_tree(self, tree: Tree) -> None:
+        self.models.append(tree)
+        self._models_dev.append(None)
+        self._models_shrink.append(1.0)
 
     # --------------------------------------------------------------- bagging
     def _bagging(self, it: int, gradients=None, hessians=None) -> None:
@@ -173,7 +285,7 @@ class GBDT:
             idx = np.argpartition(keys, self.bag_data_cnt)[:self.bag_data_cnt]
             mult = np.zeros(self.num_data, dtype=np.float32)
             mult[idx] = 1.0
-            self.row_mult = mult
+            self.row_mult = jnp.asarray(mult)
             Log.debug("Re-bagging, using %d data to train", self.bag_data_cnt)
 
     # ------------------------------------------------------------- iteration
@@ -192,108 +304,134 @@ class GBDT:
             stub = Tree(2)
             stub.split(0, 0, False, 0, 0, 0.0, init_score, init_score,
                        0, self.num_data, -1.0, 0, 0, 0.0)
-            self.train_score += init_score
-            for vs in self.valid_score:
-                vs += init_score
-            self.models.append(stub)
+            self._score_dev = self._score_dev + jnp.asarray(init_score,
+                                                            self.score_dtype)
+            self._invalidate_train()
+            for vi in range(len(self.valid_data)):
+                self._valid_score_dev[vi] = self._valid_score_dev[vi] + \
+                    jnp.asarray(init_score, self.score_dtype)
+                self._invalidate_valid(vi)
+            self._append_host_tree(stub)
             self.boost_from_average_used = True
 
-        if gradients is None or hessians is None:
+        custom = gradients is not None and hessians is not None
+        if not custom:
             if self.objective is None:
                 Log.fatal("No object function provided")
-            g, h = self.objective.get_gradients(self._score_for_objective())
-            gradients = np.array(g, dtype=np.float32).reshape(k, self.num_data)
-            hessians = np.array(h, dtype=np.float32).reshape(k, self.num_data)
+            g_dev, h_dev = self.objective.get_gradients(
+                self._score_for_objective())
+            g_dev = jnp.reshape(g_dev, (k, self.num_data))
+            h_dev = jnp.reshape(h_dev, (k, self.num_data))
+            gradients = hessians = None
         else:
             gradients = np.array(gradients, dtype=np.float32).reshape(k, self.num_data)
             hessians = np.array(hessians, dtype=np.float32).reshape(k, self.num_data)
+            g_dev = jnp.asarray(gradients)
+            h_dev = jnp.asarray(hessians)
 
-        self._bagging(self.iter, gradients, hessians)
+        # bagging / GOSS may need host gradients and may rescale them
+        g_dev, h_dev = self._bagging_with_grad(self.iter, g_dev, h_dev)
 
-        should_continue = False
+        num_leaves_this_iter = []
         for tid in range(k):
             if self.class_need_train[tid]:
-                tree, leaf_id = self.learner.train(gradients[tid], hessians[tid],
-                                                   self.row_mult)
+                dev_tree, leaf_id = self.learner.train_device(g_dev[tid],
+                                                              h_dev[tid],
+                                                              self.row_mult)
+                # device score updates (train via partition, valids via
+                # traversal) — all async
+                self._score_dev = self._score_dev.at[tid].set(
+                    dev_predict.update_score_from_partition(
+                        self._score_dev[tid], leaf_id,
+                        dev_tree.leaf_value,
+                        jnp.asarray(self.shrinkage_rate, self.score_dtype)))
+                self._invalidate_train()
+                ta = dev_predict.traversal_from_grow(dev_tree)
+                scaled = ta._replace(leaf_value=ta.leaf_value)
+                for vi in range(len(self.valid_data)):
+                    self._valid_score_dev[vi] = self._valid_score_dev[vi].at[tid].set(
+                        dev_predict.add_tree_to_score(
+                            self._valid_score_dev[vi][tid],
+                            self._valid_X_dev[vi], scaled,
+                            jnp.asarray(self.shrinkage_rate, self.score_dtype)))
+                    self._invalidate_valid(vi)
+                self.models.append(None)
+                self._models_dev.append(dev_tree)
+                self._models_shrink.append(self.shrinkage_rate)
+                num_leaves_this_iter.append(dev_tree.num_leaves)
             else:
-                tree, leaf_id = Tree(2), None
-            if tree.num_leaves > 1:
-                should_continue = True
-                tree.shrink(self.shrinkage_rate)
-                self._update_score(tree, tid, leaf_id)
-            else:
-                if (not self.class_need_train[tid]
-                        and len(self.models) < k):
+                tree = Tree(2)
+                if len(self.models) < k:
                     out = self.class_default_output[tid]
                     tree.split(0, 0, False, 0, 0, 0.0, out, out,
                                0, self.num_data, -1.0, 0, 0, 0.0)
-                    self.train_score[tid] += out
-                    for vs in self.valid_score:
-                        vs[tid] += out
-            self.models.append(tree)
+                    self._score_dev = self._score_dev.at[tid].add(
+                        jnp.asarray(out, self.score_dtype))
+                    self._invalidate_train()
+                    for vi in range(len(self.valid_data)):
+                        self._valid_score_dev[vi] = \
+                            self._valid_score_dev[vi].at[tid].add(
+                                jnp.asarray(out, self.score_dtype))
+                        self._invalidate_valid(vi)
+                self._append_host_tree(tree)
 
+        # stop check: any trained tree must have >1 leaves.  Evaluating the
+        # device scalars here costs one sync; skip it when nothing forces a
+        # sync anyway (pure fast path) and rely on the periodic check.
+        should_continue = True
+        if num_leaves_this_iter:
+            if is_eval or (self.iter % 16 == 0):
+                should_continue = any(int(nl) > 1
+                                      for nl in jax.device_get(num_leaves_this_iter))
+        else:
+            should_continue = False
         if not should_continue:
-            Log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements.")
-            for _ in range(k):
-                self.models.pop()
+            self._pop_degenerate_iterations()
             return True
         self.iter += 1
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
 
+    def _bagging_with_grad(self, it, g_dev, h_dev):
+        """Hook: base bagging ignores gradients; GOSS overrides."""
+        self._bagging(it)
+        return g_dev, h_dev
+
+    def _pop_degenerate_iterations(self) -> None:
+        """No leaf met the split requirements: drop this iteration's trees
+        and any identical degenerate tail (gbdt.cpp:440-448)."""
+        Log.warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements.")
+        k = self.num_tree_per_iteration
+        for _ in range(k):
+            self.models.pop()
+            self._models_dev.pop()
+            self._models_shrink.pop()
+
     def _score_for_objective(self):
         k = self.num_tree_per_iteration
         if k == 1:
-            return self.train_score[0]
-        return self.train_score.reshape(-1)
-
-    def _update_score(self, tree: Tree, tid: int, leaf_id) -> None:
-        """UpdateScore + UpdateScoreOutOfBag: the partition covers every row
-        (out-of-bag rows were routed too), so one gather updates all."""
-        if leaf_id is not None:
-            vals = np.asarray(leaf_outputs_to_scores(
-                leaf_id, tree.leaf_value[:max(tree.num_leaves, 1)].astype(np.float64),
-                max(tree.num_leaves, 1)))
-            self.train_score[tid] += vals
-        else:
-            tree.add_prediction_to_score(self.train_data.binned,
-                                         self.train_score[tid],
-                                         self.train_data.used_feature_idx)
-        for vd, vs in zip(self.valid_data, self.valid_score):
-            self._add_tree_score(tree, vd, vs[tid])
-
-    @staticmethod
-    def _add_tree_score(tree: Tree, data: TrainingData, score: np.ndarray) -> None:
-        """Score update on a dataset: binned traversal when the tree carries
-        bin thresholds, raw-value traversal otherwise (loaded models)."""
-        if tree.has_bin_thresholds:
-            tree.add_prediction_to_score(data.binned, score,
-                                         data.used_feature_idx)
-        elif data.raw_data is not None:
-            score += tree.predict(data.raw_data)
-        else:
-            Log.fatal("Cannot apply a loaded model to binned-only data; "
-                      "keep raw data when continuing training")
+            return self._score_dev[0]
+        return jnp.reshape(self._score_dev, (-1,))
 
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:460-477)."""
         if self.iter <= 0:
             return
+        self._materialize()
         k = self.num_tree_per_iteration
         cur_iter = self.iter + self.num_init_iteration - 1
         for tid in range(k):
             t = cur_iter * k + tid
             self.models[t].shrink(-1.0)
-            self.models[t].add_prediction_to_score(
-                self.train_data.binned, self.train_score[tid],
-                self.train_data.used_feature_idx)
-            for vd, vs in zip(self.valid_data, self.valid_score):
-                self.models[t].add_prediction_to_score(vd.binned, vs[tid],
-                                                       vd.used_feature_idx)
+            self._apply_tree_to_train(self.models[t], tid)
+            for vi in range(len(self.valid_data)):
+                self._apply_tree_to_valid(self.models[t], vi, tid)
         for _ in range(k):
             self.models.pop()
+            self._models_dev.pop()
+            self._models_shrink.pop()
         self.iter -= 1
 
     # ------------------------------------------------------------------ eval
@@ -306,6 +444,8 @@ class GBDT:
             Log.info("Output of best iteration round:\n%s", best_msg)
             for _ in range(self.early_stopping_round * self.num_tree_per_iteration):
                 self.models.pop()
+                self._models_dev.pop()
+                self._models_shrink.pop()
         return met
 
     def output_metric(self, it: int) -> str:
@@ -325,7 +465,7 @@ class GBDT:
         if need_output or self.early_stopping_round > 0:
             for i in range(len(self.valid_metrics)):
                 for j, m in enumerate(self.valid_metrics[i]):
-                    test_scores = m.eval(self.valid_score[i], self.objective)
+                    test_scores = m.eval(self.valid_score_host(i), self.objective)
                     for name, s in zip(m.get_names(), test_scores):
                         line = "Iteration:%d, valid_%d %s : %g" % (it, i + 1, name, s)
                         if need_output:
@@ -354,7 +494,7 @@ class GBDT:
         else:
             i = data_idx - 1
             for m in self.valid_metrics[i]:
-                out.extend(m.eval(self.valid_score[i], self.objective))
+                out.extend(m.eval(self.valid_score_host(i), self.objective))
         return out
 
     def eval_names(self, data_idx: int) -> List[str]:
@@ -382,6 +522,7 @@ class GBDT:
                     num_iteration: int = -1) -> np.ndarray:
         """Raw scores (N, num_tree_per_iteration) on real-valued features
         (gbdt_prediction.cpp PredictRaw)."""
+        self._materialize()
         features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
         n = features.shape[0]
         k = self.num_tree_per_iteration
@@ -405,6 +546,7 @@ class GBDT:
 
     def predict_leaf_index(self, features: np.ndarray,
                            num_iteration: int = -1) -> np.ndarray:
+        self._materialize()
         features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
         num_used = self._used_trees(num_iteration)
         cols = [self.models[t].predict_leaf_index(features)
@@ -417,6 +559,7 @@ class GBDT:
 
     def save_model_to_string(self, num_iteration: int = -1) -> str:
         """GBDT::SaveModelToString (gbdt.cpp:817-861)."""
+        self._materialize()
         lines = [self.sub_model_name()]
         lines.append("num_class=%d" % self.num_class)
         lines.append("num_tree_per_iteration=%d" % self.num_tree_per_iteration)
@@ -446,6 +589,8 @@ class GBDT:
     def load_model_from_string(self, model_str: str) -> bool:
         """GBDT::LoadModelFromString (gbdt.cpp:875-971)."""
         self.models = []
+        self._models_dev = []
+        self._models_shrink = []
         lines = model_str.splitlines()
         header_lines = []
         for line in lines:
@@ -479,7 +624,6 @@ class GBDT:
         parts = text.split("Tree=")
         for part in parts[1:]:
             block_lines = part.splitlines()
-            # first line is the tree index
             body = []
             for bl in block_lines[1:]:
                 if bl.startswith("feature importances"):
@@ -487,7 +631,7 @@ class GBDT:
                 body.append(bl)
             block = "\n".join(body).strip()
             if block:
-                self.models.append(Tree.from_string(block))
+                self._append_host_tree(Tree.from_string(block))
         self.num_iteration_for_pred = len(self.models) // max(self.num_tree_per_iteration, 1)
         self.num_init_iteration = self.num_iteration_for_pred
         self.iter = 0
@@ -495,6 +639,7 @@ class GBDT:
 
     def dump_model(self, num_iteration: int = -1) -> str:
         """GBDT::DumpModel JSON (gbdt.cpp:665-699)."""
+        self._materialize()
         out = ['{"name":"%s",' % self.sub_model_name(),
                '"num_class":%d,' % self.num_class,
                '"num_tree_per_iteration":%d,' % self.num_tree_per_iteration,
@@ -516,11 +661,7 @@ class GBDT:
     # ------------------------------------------------------------ importance
     def feature_importance_pairs(self) -> List[Tuple[int, str]]:
         """Split-count importance, descending, stable (gbdt.cpp:973-997)."""
-        counts = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
-        for tree in self.models:
-            for i in range(tree.num_leaves - 1):
-                if tree.split_gain[i] > 0:
-                    counts[tree.split_feature[i]] += 1
+        counts = self.feature_importance()
         pairs = [(int(counts[i]), self.feature_names[i] if i < len(self.feature_names)
                   else "Column_%d" % i)
                  for i in range(len(counts)) if counts[i] > 0]
@@ -528,6 +669,7 @@ class GBDT:
         return pairs
 
     def feature_importance(self) -> np.ndarray:
+        self._materialize()
         counts = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
         for tree in self.models:
             for i in range(tree.num_leaves - 1):

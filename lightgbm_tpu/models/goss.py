@@ -2,17 +2,19 @@
 
 Gradient-based One-Side Sampling: keep the top ``top_rate`` fraction of rows
 by |g*h| (summed over classes), sample ``other_rate`` of the rest uniformly
-and amplify their gradients by (1-top_rate-ish) factor
-``(cnt - top_k) / other_k`` (goss.hpp:79-125).  No sampling for the first
-``1 / learning_rate`` iterations (goss.hpp:128-130).
+and amplify their gradients by ``(cnt - top_k) / other_k``
+(goss.hpp:79-125).  No sampling for the first ``1 / learning_rate``
+iterations (goss.hpp:128-130).
 
-Realized as the row-multiplier mask the TPU learner already consumes —
-gradient amplification is applied in place to the gradient arrays, exactly
-like the reference mutates ``gradients_``.
+TPU-native: the whole selection runs on device (top-k threshold via
+jnp.partition-style sort, uniform rest-sample via a per-iteration hashed
+key), producing the row multiplier the learner consumes plus rescaled
+gradients — no host round-trip.
 """
 from __future__ import annotations
 
-import numpy as np
+import jax
+import jax.numpy as jnp
 
 from ..utils.log import Log
 from .gbdt import GBDT
@@ -26,34 +28,32 @@ class GOSS(GBDT):
             Log.fatal("cannot use bagging in GOSS")
         Log.info("Using GOSS")
         if train_data is not None:
-            # GOSS owns bagging entirely
             self.bag_data_cnt = self.num_data
 
-    def _bagging(self, it: int, gradients=None, hessians=None) -> None:
+    def _bagging_with_grad(self, it: int, g_dev, h_dev):
         cfg = self.config
         self.row_mult = None
         if it < int(1.0 / cfg.learning_rate):
-            return
-        if gradients is None:
-            return
+            return g_dev, h_dev
         n = self.num_data
-        g = np.abs(np.asarray(gradients) * np.asarray(hessians)).reshape(
-            self.num_tree_per_iteration, n).sum(axis=0)
         top_k = max(1, int(n * cfg.top_rate))
         other_k = int(n * cfg.other_rate)
-        order = np.argpartition(-g, top_k - 1)
-        threshold = g[order[top_k - 1]]
-        is_top = g >= threshold
-        rest_idx = np.nonzero(~is_top)[0]
-        mult = np.zeros(n, dtype=np.float32)
-        mult[is_top] = 1.0
-        if other_k > 0 and len(rest_idx) > 0:
-            rng = np.random.default_rng(cfg.bagging_seed + it)
-            take = min(other_k, len(rest_idx))
-            sampled = rng.choice(rest_idx, size=take, replace=False)
-            mult[sampled] = 1.0
-            multiply = (n - top_k) / other_k
-            for tid in range(self.num_tree_per_iteration):
-                gradients[tid][sampled] *= multiply
-                hessians[tid][sampled] *= multiply
+        if other_k <= 0:
+            return g_dev, h_dev
+        multiply = (n - top_k) / other_k
+        key = jax.random.PRNGKey(cfg.bagging_seed + it)
+
+        absg = jnp.sum(jnp.abs(g_dev * h_dev), axis=0)
+        # threshold = top_k-th largest |g*h| (ArgMaxAtK, goss.hpp:90-92)
+        threshold = -jnp.sort(-absg)[top_k - 1]
+        is_top = absg >= threshold
+        # uniform exact-count sample of the rest: rank random keys, keep the
+        # other_k smallest among non-top rows
+        u = jax.random.uniform(key, (n,))
+        u = jnp.where(is_top, jnp.inf, u)
+        kth = jnp.sort(u)[other_k - 1]
+        sampled = (~is_top) & (u <= kth)
+        mult = jnp.where(is_top | sampled, 1.0, 0.0).astype(g_dev.dtype)
+        scale = jnp.where(sampled, multiply, 1.0).astype(g_dev.dtype)
         self.row_mult = mult
+        return g_dev * scale[None, :], h_dev * scale[None, :]

@@ -4,14 +4,15 @@ Trains with shrinkage 1, then re-weights each new tree so the ensemble
 converges to a capacity-bounded F:  eta_m = 2/(m+1) contribution,
 F -> (1-eta)F + eta*capacity*tree, final tree weight
 ``capacity * m / sum(1..n)`` with a 0.2 max contribution
-(infiniteboost.hpp:70-113).
+(infiniteboost.hpp:70-113).  The F rescale is a device multiply
+(ScoreUpdater::MultiplyScore).
 
 Deviation from the reference: tree indices account for the
 boost_from_average stub tree.
 """
 from __future__ import annotations
 
-import numpy as np
+import jax.numpy as jnp
 
 from .gbdt import GBDT
 
@@ -42,6 +43,16 @@ class InfiniteBoost(GBDT):
             self.output_metric(self.iter)
         return False
 
+    def _multiply_train(self, tid: int, factor: float):
+        self._score_dev = self._score_dev.at[tid].set(
+            self._score_dev[tid] * jnp.asarray(factor, self.score_dtype))
+        self._invalidate_train()
+
+    def _multiply_valid(self, vi: int, tid: int, factor: float):
+        self._valid_score_dev[vi] = self._valid_score_dev[vi].at[tid].set(
+            self._valid_score_dev[vi][tid] * jnp.asarray(factor, self.score_dtype))
+        self._invalidate_valid(vi)
+
     def _update_tree_weight(self) -> None:
         """infiniteboost.hpp:70-113."""
         m = self.iter
@@ -49,22 +60,23 @@ class InfiniteBoost(GBDT):
         tree_contribution = min(eta * self.capacity, MAXIMAL_CONTRIBUTION)
         self.current_normalization += m
         k = self.num_tree_per_iteration
+        self._materialize()
         for tid in range(k):
             tree = self.models[self._stub_offset() + (m - 1) * k + tid]
             # remove GBDT's contribution, scale F by (1-eta), add back with
             # the capped contribution
             tree.shrink(-1.0)
-            for vd, vs in zip(self.valid_data, self.valid_score):
-                self._add_tree_score(tree, vd, vs[tid])
-                vs[tid] *= (1.0 - eta)
-            self._add_tree_score(tree, self.train_data, self.train_score[tid])
-            self.train_score[tid] *= (1.0 - eta)
+            for vi in range(len(self.valid_data)):
+                self._apply_tree_to_valid(tree, vi, tid)
+                self._multiply_valid(vi, tid, 1.0 - eta)
+            self._apply_tree_to_train(tree, tid)
+            self._multiply_train(tid, 1.0 - eta)
         for tid in range(k):
             tree = self.models[self._stub_offset() + (m - 1) * k + tid]
             tree.shrink(-tree_contribution)
-            for vd, vs in zip(self.valid_data, self.valid_score):
-                self._add_tree_score(tree, vd, vs[tid])
-            self._add_tree_score(tree, self.train_data, self.train_score[tid])
+            for vi in range(len(self.valid_data)):
+                self._apply_tree_to_valid(tree, vi, tid)
+            self._apply_tree_to_train(tree, tid)
             tree.shrink(1.0 / tree_contribution * min(
                 self.capacity * m / self.normalization,
                 MAXIMAL_CONTRIBUTION * self.current_normalization / self.normalization))

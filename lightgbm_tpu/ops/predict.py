@@ -1,0 +1,116 @@
+"""Device-side tree application: traversal on binned data + score updates.
+
+Replaces Tree::AddPredictionToScore (src/io/tree.cpp) and the train-side
+ScoreUpdater::AddScore-via-partition (score_updater.hpp:91-99) with jitted
+XLA programs so boosting iterations never synchronize with the host.
+Decision semantics match dense_bin.hpp:190-222 (default-bin redirect,
+numerical <=, categorical ==).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.common import kMaxTreeOutput
+
+
+class TraversalArrays(NamedTuple):
+    """Minimal device arrays needed to traverse a tree on binned data."""
+    num_leaves: jnp.ndarray        # scalar i32
+    split_feature: jnp.ndarray     # (L-1,) i32 (inner index)
+    threshold_bin: jnp.ndarray     # (L-1,) i32
+    default_bin_for_zero: jnp.ndarray  # (L-1,) i32
+    default_bin: jnp.ndarray       # (L-1,) i32
+    is_cat: jnp.ndarray            # (L-1,) i32
+    left_child: jnp.ndarray        # (L-1,) i32
+    right_child: jnp.ndarray       # (L-1,) i32
+    leaf_value: jnp.ndarray        # (L,) f
+
+
+def traversal_from_grow(tree_arrays) -> TraversalArrays:
+    """View ops.grow.TreeArrays as TraversalArrays (shared buffers)."""
+    return TraversalArrays(
+        num_leaves=tree_arrays.num_leaves,
+        split_feature=tree_arrays.split_feature,
+        threshold_bin=tree_arrays.threshold_bin,
+        default_bin_for_zero=tree_arrays.default_bin_for_zero,
+        default_bin=tree_arrays.default_bin,
+        is_cat=tree_arrays.is_cat,
+        left_child=tree_arrays.left_child,
+        right_child=tree_arrays.right_child,
+        leaf_value=tree_arrays.leaf_value,
+    )
+
+
+def traversal_from_host_tree(tree, dtype=jnp.float32) -> TraversalArrays:
+    """Upload a models.Tree (with bin thresholds) for device traversal."""
+    ni = max(tree.num_leaves - 1, 1)
+    nl = max(tree.num_leaves, 2)
+    return TraversalArrays(
+        num_leaves=jnp.asarray(tree.num_leaves, jnp.int32),
+        split_feature=jnp.asarray(tree.split_feature_inner[:ni], jnp.int32),
+        threshold_bin=jnp.asarray(tree.threshold_in_bin[:ni], jnp.int32),
+        default_bin_for_zero=jnp.asarray(tree.default_bin_for_zero[:ni], jnp.int32),
+        default_bin=jnp.asarray(tree.zero_bin[:ni], jnp.int32),
+        is_cat=jnp.asarray(tree.decision_type[:ni], jnp.int32),
+        left_child=jnp.asarray(tree.left_child[:ni], jnp.int32),
+        right_child=jnp.asarray(tree.right_child[:ni], jnp.int32),
+        leaf_value=jnp.asarray(tree.leaf_value[:nl], dtype),
+    )
+
+
+@jax.jit
+def leaf_index_binned(tree: TraversalArrays, X):
+    """Per-row leaf index by iterative descent (Tree::GetLeaf semantics on
+    bins); returns zeros for single-leaf trees."""
+    n = X.shape[0]
+    rows = jnp.arange(n)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        f = tree.split_feature[nd]
+        b = X[rows, f].astype(jnp.int32)
+        thr = tree.threshold_bin[nd]
+        cat = tree.is_cat[nd] > 0
+        dbz = tree.default_bin_for_zero[nd]
+        dflt = tree.default_bin[nd]
+        go_left = jnp.where(cat, b == thr, b <= thr)
+        def_left = jnp.where(cat, dbz == thr, dbz <= thr)
+        go_left = jnp.where(b == dflt, def_left, go_left)
+        nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    init = jnp.where(tree.num_leaves > 1,
+                     jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32))
+    node = lax.while_loop(cond, body, init)
+    return jnp.where(tree.num_leaves > 1, ~node, 0)
+
+
+@jax.jit
+def add_tree_to_score(score, X, tree: TraversalArrays, scale):
+    """score += scale * clip(leaf_value)[leaf(X)] — Tree::AddPredictionToScore
+    with the Shrinkage clamp (tree.h:110-118) applied at read time."""
+    leaf = leaf_index_binned(tree, X)
+    vals = jnp.clip(tree.leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
+    add = jnp.where(tree.num_leaves > 1, vals[leaf], 0.0)
+    return score + add.astype(score.dtype)
+
+
+@jax.jit
+def update_score_from_partition(score, leaf_id, leaf_value, scale):
+    """Train-side score update via the learner's final partition
+    (score_updater.hpp:91-99): score += clip(scale * leaf_value)[leaf_id]."""
+    vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
+    return score + vals[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)].astype(score.dtype)
+
+
+@jax.jit
+def add_constant_to_score(score, value):
+    return score + value
