@@ -272,14 +272,15 @@ class Booster:
             if objective is not None:
                 objective.init(train_set._handle.metadata,
                                train_set._handle.num_data)
+            # training metrics always exist; is_training_metric only gates
+            # auto-printing (c_api.cpp CreateObjectiveAndMetrics semantics)
             training_metrics = []
-            if cfg.is_training_metric or self.params.get("is_training_metric"):
-                for mname in cfg.metrics():
-                    m = create_metric(mname, cfg)
-                    if m is not None:
-                        m.init(train_set._handle.metadata,
-                               train_set._handle.num_data)
-                        training_metrics.append(m)
+            for mname in cfg.metrics():
+                m = create_metric(mname, cfg)
+                if m is not None:
+                    m.init(train_set._handle.metadata,
+                           train_set._handle.num_data)
+                    training_metrics.append(m)
             self._gbdt = create_boosting(cfg.boosting_type, cfg,
                                          train_set._handle, objective,
                                          training_metrics)
@@ -287,7 +288,10 @@ class Booster:
             # continuation: fold loaded models in
             if train_set._predictor is not None:
                 base = train_set._predictor.gbdt
+                base._materialize()
                 self._gbdt.models = list(base.models) + self._gbdt.models
+                self._gbdt._models_dev = [None] * len(base.models) + self._gbdt._models_dev
+                self._gbdt._models_shrink = [1.0] * len(base.models) + self._gbdt._models_shrink
                 self._gbdt.num_init_iteration = (
                     len(base.models) // max(base.num_tree_per_iteration, 1))
                 self._gbdt.boost_from_average_used = base.boost_from_average_used

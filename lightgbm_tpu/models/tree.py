@@ -241,7 +241,7 @@ class Tree:
     def to_json(self) -> str:
         """Tree::ToJSON (tree.cpp:345-358)."""
         out = ['"num_leaves":%d,' % self.num_leaves,
-               '"shrinkage":%s,' % repr(self.shrinkage),
+               '"shrinkage":%s,' % repr(float(self.shrinkage)),
                '"has_categorical":%d,' % (1 if self.has_categorical else 0)]
         root = -1 if self.num_leaves == 1 else 0
         out.append('"tree_structure":' + self._node_to_json(root))
@@ -261,10 +261,10 @@ class Tree:
                     '"left_child":%s,\n'
                     '"right_child":%s\n'
                     "}") % (
-                index, self.split_feature[index], repr(self.split_gain[index]),
-                repr(self.threshold[index]),
+                index, self.split_feature[index], repr(float(self.split_gain[index])),
+                repr(float(self.threshold[index])),
                 "no_greater" if self.decision_type[index] == 0 else "is",
-                repr(self.default_value[index]), repr(self.internal_value[index]),
+                repr(float(self.default_value[index])), repr(float(self.internal_value[index])),
                 self.internal_count[index],
                 self._node_to_json(self.left_child[index]),
                 self._node_to_json(self.right_child[index]))
@@ -275,7 +275,7 @@ class Tree:
                 '"leaf_value":%s,\n'
                 '"leaf_count":%d\n'
                 "}") % (leaf, self.leaf_parent[leaf],
-                        repr(self.leaf_value[leaf]), self.leaf_count[leaf])
+                        repr(float(self.leaf_value[leaf])), self.leaf_count[leaf])
 
     # ------------------------------------------------------------- analysis
     def depth_of_leaf(self, leaf: int) -> int:

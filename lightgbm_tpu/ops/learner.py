@@ -171,14 +171,17 @@ def materialize_tree(host_tree: TreeArrays, train_data: TrainingData,
     tree.leaf_value[:nl] = host_tree.leaf_value[:nl]
     tree.leaf_count[:nl] = host_tree.leaf_count[:nl]
     tree.leaf_depth[:nl] = host_tree.leaf_depth[:nl]
+    from ..utils.common import avoid_inf
     for i in range(ni):
         inner_f = int(host_tree.split_feature[i])
         mapper = train_data.feature_bin_mapper(inner_f)
         tree.split_feature[i] = train_data.real_feature_index(inner_f)
-        tree.threshold[i] = mapper.bin_to_value(int(host_tree.threshold_bin[i]))
+        tree.threshold[i] = avoid_inf(
+            mapper.bin_to_value(int(host_tree.threshold_bin[i])))
         dbz = int(host_tree.default_bin_for_zero[i])
         if dbz != mapper.default_bin:
-            tree.default_value[i] = mapper.bin_to_value(dbz)
+            # AvoidInf as in Tree::Split (tree.cpp:75)
+            tree.default_value[i] = avoid_inf(mapper.bin_to_value(dbz))
         else:
             tree.default_value[i] = 0.0
     return tree

@@ -13,23 +13,23 @@ import numpy as np
 
 class Random:
     def __init__(self, seed: int = 123456789):
-        self.x = np.uint32(seed)
+        self.x = int(seed) & 0xFFFFFFFF
 
-    def _next(self) -> np.uint32:
-        self.x = np.uint32(214013) * self.x + np.uint32(2531011)
+    def _next(self) -> int:
+        self.x = (214013 * self.x + 2531011) & 0xFFFFFFFF
         return self.x
 
     def next_short(self, lower: int, upper: int) -> int:
         """Random int in [lower, upper) from the 15-bit extraction."""
-        r = int((int(self._next()) >> 16) & 0x7FFF)
+        r = (self._next() >> 16) & 0x7FFF
         return r % (upper - lower) + lower
 
     def next_int(self, lower: int, upper: int) -> int:
-        r = int(self._next()) & 0x7FFFFFFF
+        r = self._next() & 0x7FFFFFFF
         return r % (upper - lower) + lower
 
     def next_float(self) -> float:
-        r = int((int(self._next()) >> 16) & 0x7FFF)
+        r = (self._next() >> 16) & 0x7FFF
         return r / 32768.0
 
     def sample(self, n: int, k: int) -> np.ndarray:

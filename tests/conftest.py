@@ -1,0 +1,15 @@
+"""Test harness config: force the CPU backend with 8 virtual devices.
+
+This is the moral equivalent of the reference testing its GPU code on an
+OpenCL CPU driver and MPI single-process (.travis.yml:15-25,45-59): the
+multi-device psum paths run on a virtual 8-device CPU mesh, no TPU pod
+needed (SURVEY.md §4).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

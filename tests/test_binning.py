@@ -1,0 +1,85 @@
+"""Binning unit tests against hand-computed oracles (bin.cpp semantics)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BinMapper, CATEGORICAL, NUMERICAL,
+                                     greedy_find_bin, need_filter)
+
+
+def test_greedy_few_distinct():
+    # num_distinct <= max_bin: bounds at midpoints, honoring min_data_in_bin
+    dv = np.array([1.0, 2.0, 3.0])
+    cnt = np.array([10, 10, 10])
+    bounds = greedy_find_bin(dv, cnt, 3, 255, 30, 5)
+    assert bounds == [1.5, 2.5, np.inf]
+
+
+def test_greedy_min_data_in_bin_merges():
+    dv = np.array([1.0, 2.0, 3.0])
+    cnt = np.array([2, 2, 10])
+    bounds = greedy_find_bin(dv, cnt, 3, 255, 14, 3)
+    # first bin must absorb >=3 samples -> boundary after value 2
+    assert bounds[0] == 2.5
+
+
+def test_find_bin_zero_bin_reserved():
+    m = BinMapper()
+    vals = np.array([-2.0, -1.0, 1.0, 2.0, 3.0] * 10)
+    m.find_bin(vals, 100, 255, 1, 1, NUMERICAL)  # 50 implicit zeros
+    # the zero range must have a dedicated bin, default_bin = bin of 0.0
+    assert m.default_bin == m.value_to_bin(0.0)
+    assert m.value_to_bin(1e-21) == m.default_bin
+    assert m.value_to_bin(-1e-21) == m.default_bin
+    assert m.value_to_bin(-1.0) < m.default_bin
+    assert m.value_to_bin(1.0) > m.default_bin
+
+
+def test_find_bin_monotone_and_bounds():
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=1000)
+    m = BinMapper()
+    m.find_bin(vals, 1000, 63, 3, 5, NUMERICAL)
+    assert m.num_bin <= 63
+    # mapping must be monotone for numerical features
+    xs = np.linspace(-3, 3, 500)
+    bins = m.value_to_bin(xs)
+    assert (np.diff(bins) >= 0).all()
+    # each value lands in the bin whose upper bound dominates it
+    for x in (-2.5, -0.5, 0.0, 0.7, 2.9):
+        b = m.value_to_bin(x)
+        assert x <= m.bin_upper_bound[b]
+        if b > 0:
+            assert x > m.bin_upper_bound[b - 1]
+
+
+def test_categorical_count_order():
+    vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 20)
+    m = BinMapper()
+    m.find_bin(vals, 100, 255, 1, 1, CATEGORICAL)
+    # count-sorted: category 3 -> bin 0, 7 -> bin 1, 1 -> bin 2
+    assert m.value_to_bin(3) == 0
+    assert m.value_to_bin(7) == 1
+    assert m.value_to_bin(1) == 2
+    # unseen category maps to last bin (bin.h:433-440)
+    assert m.value_to_bin(99) == m.num_bin - 1
+
+
+def test_trivial_feature():
+    m = BinMapper()
+    m.find_bin(np.array([]), 100, 255, 3, 5, NUMERICAL)  # all zeros
+    assert m.is_trivial
+
+
+def test_need_filter():
+    # 10 in bin0, 10 in bin1: a split at bin0 leaves 10/10
+    assert not need_filter([10, 10], 20, 5, NUMERICAL)
+    assert need_filter([1, 19], 20, 5, NUMERICAL)
+
+
+def test_serialization_roundtrip():
+    rng = np.random.default_rng(1)
+    m = BinMapper()
+    m.find_bin(rng.normal(size=500), 600, 63, 3, 5, NUMERICAL)
+    m2 = BinMapper.from_dict(m.to_dict())
+    xs = rng.normal(size=100)
+    assert (m.value_to_bin(xs) == m2.value_to_bin(xs)).all()

@@ -1,0 +1,235 @@
+"""End-to-end convergence thresholds per task — the reference's
+tests/python_package_test/test_engine.py:33-91 strategy."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_binary(n=1200, f=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.5 + X[:, 1] - X[:, 2] * 0.5 + 0.3 * rng.normal(size=n)
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def make_regression(n=1200, f=10, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 3 + np.sin(X[:, 1] * 2) * 2 + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def test_binary_convergence():
+    X, y = make_binary()
+    Xt, yt = make_binary(seed=7)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "num_leaves": 31, "verbose": -1},
+                    train, num_boost_round=60, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    loss = evals["valid_0"]["binary_logloss"][-1]
+    assert loss < 0.25
+    # probability output in [0,1]
+    p = bst.predict(Xt)
+    assert p.min() >= 0 and p.max() <= 1
+
+
+def test_regression_convergence():
+    X, y = make_regression()
+    Xt, yt = make_regression(seed=9)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    lgb.train({"objective": "regression", "metric": "l2", "verbose": -1},
+              train, num_boost_round=80, valid_sets=[valid],
+              evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["l2"][-1] < 1.0
+
+
+def test_multiclass_convergence():
+    rng = np.random.default_rng(3)
+    n = 900
+    X = rng.normal(size=(n, 8))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    train = lgb.Dataset(X, label=y.astype(float))
+    evals = {}
+    lgb.train({"objective": "multiclass", "num_class": 3,
+               "metric": "multi_logloss", "verbose": -1},
+              train, num_boost_round=50, valid_sets=[train],
+              evals_result=evals, verbose_eval=False)
+    assert evals["training"]["multi_logloss"][-1] < 0.35
+
+
+def test_early_stopping():
+    X, y = make_binary()
+    Xt, yt = make_binary(seed=11)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, yt)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "verbose": -1, "num_leaves": 63, "learning_rate": 0.5},
+                    train, num_boost_round=400, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() < 400
+
+
+def test_model_file_roundtrip(tmp_path):
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-15)
+    # string round-trip preserves re-save exactly (test_basic.py:40-47)
+    assert bst2.model_to_string() == lgb.Booster(
+        model_str=bst.model_to_string()).model_to_string()
+
+
+def test_continued_training():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst1 = lgb.train({"objective": "binary", "verbose": -1}, train,
+                     num_boost_round=10, verbose_eval=False)
+    train2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst2 = lgb.train({"objective": "binary", "verbose": -1}, train2,
+                     num_boost_round=10, init_model=bst1, verbose_eval=False)
+    assert bst2.num_trees() > bst1.num_trees()
+    # continued model must improve (or match) training loss
+    p1 = bst1.predict(X)
+    p2 = bst2.predict(X)
+    def logloss(p):
+        p = np.clip(p, 1e-12, 1 - 1e-12)
+        return -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    assert logloss(p2) <= logloss(p1) + 1e-9
+
+
+def test_custom_objective_fobj():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+
+    def fobj(preds, dataset):
+        labels = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1 - p)
+
+    bst = lgb.train({"verbose": -1, "num_leaves": 31}, train,
+                    num_boost_round=30, fobj=fobj, verbose_eval=False)
+    p = 1.0 / (1.0 + np.exp(-bst.predict(X, raw_score=True)))
+    acc = ((p > 0.5) == (y > 0)).mean()
+    assert acc > 0.9
+
+
+def test_feval():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(X, y)
+
+    def feval(preds, dataset):
+        labels = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return "my_err", float(((p > 0.5) != (labels > 0)).mean()), False
+
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "binary_logloss",
+               "verbose": -1}, train, num_boost_round=10,
+              valid_sets=[valid], feval=feval, evals_result=evals,
+              verbose_eval=False)
+    assert "my_err" in evals["valid_0"]
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_binary(n=2000)
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "auc", "verbose": -1,
+               "bagging_fraction": 0.7, "bagging_freq": 1,
+               "feature_fraction": 0.8},
+              train, num_boost_round=40, valid_sets=[train],
+              evals_result=evals, verbose_eval=False)
+    assert evals["training"]["auc"][-1] > 0.95
+
+
+def test_weights_affect_training():
+    X, y = make_binary()
+    w = np.where(y > 0, 10.0, 1.0)
+    train = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=20, verbose_eval=False)
+    p_w = bst.predict(X).mean()
+    train0 = lgb.Dataset(X, label=y)
+    bst0 = lgb.train({"objective": "binary", "verbose": -1}, train0,
+                     num_boost_round=20, verbose_eval=False)
+    assert p_w > bst0.predict(X).mean()   # upweighted positives shift probs
+
+
+def test_max_depth():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "max_depth": 3,
+                     "num_leaves": 63}, train, num_boost_round=5,
+                    verbose_eval=False)
+    model = bst.dump_model()
+    def depth(node, d=0):
+        if "leaf_index" in node:
+            return d
+        return max(depth(node["left_child"], d + 1),
+                   depth(node["right_child"], d + 1))
+    for info in model["tree_info"]:
+        assert depth(info["tree_structure"]) <= 3
+
+
+def test_lambdarank():
+    rng = np.random.default_rng(5)
+    n_q, per_q = 60, 12
+    n = n_q * per_q
+    X = rng.normal(size=(n, 6))
+    rel = (X[:, 0] + 0.5 * rng.normal(size=n))
+    y = np.clip(np.digitize(rel, [-0.5, 0.5, 1.2]), 0, 3).astype(float)
+    group = np.full(n_q, per_q)
+    train = lgb.Dataset(X, label=y, group=group)
+    evals = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "ndcg_eval_at": [3], "verbose": -1, "min_data_in_leaf": 5},
+              train, num_boost_round=30, valid_sets=[train],
+              evals_result=evals, verbose_eval=False)
+    ndcg = evals["training"]["ndcg@3"]
+    assert ndcg[-1] > ndcg[0]
+    assert ndcg[-1] > 0.8
+
+
+def test_cv():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "verbose": -1}, train, num_boost_round=10, nfold=3)
+    assert "binary_logloss-mean" in res
+    assert len(res["binary_logloss-mean"]) == 10
+
+
+def test_boosting_variants():
+    X, y = make_binary()
+    for boosting in ("dart", "goss"):
+        train = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "boosting": boosting,
+                         "verbose": -1, "learning_rate": 0.1},
+                        train, num_boost_round=15, verbose_eval=False)
+        p = bst.predict(X)
+        acc = ((p > 0.5) == (y > 0)).mean()
+        assert acc > 0.85, boosting
+
+
+def test_infiniteboost():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "infinite",
+                     "capacity": 20.0, "verbose": -1},
+                    train, num_boost_round=25, verbose_eval=False)
+    p = bst.predict(X)
+    acc = ((p > 0.5) == (y > 0)).mean()
+    assert acc > 0.85

@@ -1,0 +1,187 @@
+"""Split-finder tests against a numpy oracle implementing
+feature_histogram.hpp:78-387 literally (sequential scans)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.split_finder import (FeatureMeta, SplitParams,
+                                           find_best_split, GAIN, FEATURE,
+                                           THRESHOLD, DEFAULT_BIN_FOR_ZERO,
+                                           LEFT_OUTPUT, RIGHT_OUTPUT,
+                                           LEFT_COUNT, RIGHT_COUNT)
+
+kEps = 1e-15
+
+
+def oracle_gls(g, h, l1, l2):
+    reg = max(abs(g) - l1, 0.0)
+    return reg * reg / (h + l2)
+
+
+def oracle_numerical(hist_g, hist_h, hist_c, num_bin, default_bin,
+                     total_g, total_h, total_cnt, p: SplitParams):
+    """Literal port of FindBestThresholdNumerical + 3 sequences."""
+    total_h = total_h + 2 * kEps
+    gain_shift = oracle_gls(total_g, total_h, p.lambda_l1, p.lambda_l2)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+    best = {"gain": -np.inf}
+
+    def sequence(dbz):
+        nonlocal best
+        dirn = 1 if dbz == num_bin - 1 else -1
+        skip_default = not (0 < dbz < num_bin - 1)
+        sg, sh, sc = 0.0, kEps, 0.0
+        bb = {"gain": -np.inf}
+        if dirn == -1:
+            for t in range(num_bin - 1, 0, -1):
+                if skip_default and t == default_bin:
+                    continue
+                sg += hist_g[t]; sh += hist_h[t]; sc += hist_c[t]
+                if sc < p.min_data_in_leaf or sh < p.min_sum_hessian_in_leaf:
+                    continue
+                lc = total_cnt - sc
+                if lc < p.min_data_in_leaf:
+                    break
+                lh = total_h - sh
+                if lh < p.min_sum_hessian_in_leaf:
+                    break
+                lg = total_g - sg
+                cur = oracle_gls(lg, lh, p.lambda_l1, p.lambda_l2) + \
+                    oracle_gls(sg, sh, p.lambda_l1, p.lambda_l2)
+                if cur <= min_gain_shift:
+                    continue
+                if cur > bb["gain"]:
+                    bb = {"gain": cur, "thr": t - 1, "lg": lg, "lh": lh,
+                          "lc": lc, "dbz": dbz}
+        else:
+            for t in range(0, num_bin - 1):
+                if skip_default and t == default_bin:
+                    continue
+                sg += hist_g[t]; sh += hist_h[t]; sc += hist_c[t]
+                if sc < p.min_data_in_leaf or sh < p.min_sum_hessian_in_leaf:
+                    continue
+                rc = total_cnt - sc
+                if rc < p.min_data_in_leaf:
+                    break
+                rh = total_h - sh
+                if rh < p.min_sum_hessian_in_leaf:
+                    break
+                rg = total_g - sg
+                cur = oracle_gls(sg, sh, p.lambda_l1, p.lambda_l2) + \
+                    oracle_gls(rg, rh, p.lambda_l1, p.lambda_l2)
+                if cur <= min_gain_shift:
+                    continue
+                if cur > bb["gain"]:
+                    bb = {"gain": cur, "thr": t, "lg": sg, "lh": sh,
+                          "lc": sc, "dbz": dbz}
+        if bb["gain"] > best["gain"]:
+            best = bb
+
+    if p.use_missing:
+        sequence(0)
+        if 0 < default_bin < num_bin - 1:
+            sequence(default_bin)
+        if num_bin > 2:
+            sequence(num_bin - 1)
+    else:
+        sequence(default_bin)
+    if best["gain"] == -np.inf:
+        return None
+    best["gain"] -= min_gain_shift
+    return best
+
+
+def run_case(rng, num_bin, default_bin, l1=0.0, l2=0.0, min_data=1,
+             min_hess=1e-3, use_missing=True, min_gain=0.0):
+    B = 16
+    hist_g = np.zeros(B)
+    hist_h = np.zeros(B)
+    hist_c = np.zeros(B)
+    hist_g[:num_bin] = rng.normal(size=num_bin) * 10
+    hist_h[:num_bin] = rng.uniform(0.5, 2.0, size=num_bin) * 5
+    hist_c[:num_bin] = rng.integers(1, 50, size=num_bin)
+    tg, th, tc = hist_g.sum(), hist_h.sum(), hist_c.sum()
+    params = SplitParams(l1, l2, min_gain, float(min_data), min_hess,
+                         use_missing)
+    meta = FeatureMeta(num_bin=jnp.asarray([num_bin], jnp.int32),
+                       default_bin=jnp.asarray([default_bin], jnp.int32),
+                       is_categorical=jnp.asarray([False]))
+    hist = jnp.asarray(np.stack([hist_g, hist_h, hist_c], -1)[None],
+                       jnp.float32)
+    out = np.asarray(find_best_split(hist, tg, th, tc, meta,
+                                     jnp.asarray([True]), params))
+    oracle = oracle_numerical(hist_g, hist_h, hist_c, num_bin, default_bin,
+                              tg, th, tc, params)
+    return out, oracle
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("default_bin", [0, 3, 9])
+def test_numerical_matches_oracle(seed, default_bin):
+    rng = np.random.default_rng(seed)
+    out, oracle = run_case(rng, num_bin=10, default_bin=default_bin)
+    if oracle is None:
+        assert out[GAIN] == -np.inf or out[GAIN] <= 0
+        return
+    assert out[GAIN] == pytest.approx(oracle["gain"], rel=2e-5)
+    assert int(out[THRESHOLD]) == oracle["thr"]
+    assert int(out[DEFAULT_BIN_FOR_ZERO]) == oracle["dbz"]
+    assert out[LEFT_COUNT] == pytest.approx(oracle["lc"])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_numerical_with_l1_l2_and_constraints(seed):
+    rng = np.random.default_rng(100 + seed)
+    out, oracle = run_case(rng, num_bin=12, default_bin=5, l1=2.0, l2=3.0,
+                           min_data=30, min_hess=1.0)
+    if oracle is None:
+        assert not np.isfinite(out[GAIN]) or out[GAIN] <= 0
+        return
+    assert out[GAIN] == pytest.approx(oracle["gain"], rel=2e-5)
+    assert int(out[THRESHOLD]) == oracle["thr"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_numerical_no_missing(seed):
+    rng = np.random.default_rng(200 + seed)
+    out, oracle = run_case(rng, num_bin=8, default_bin=4, use_missing=False)
+    if oracle is None:
+        return
+    assert out[GAIN] == pytest.approx(oracle["gain"], rel=2e-5)
+    assert int(out[THRESHOLD]) == oracle["thr"]
+
+
+def test_categorical_one_vs_rest():
+    # categorical: best split isolates the bin with extreme gradient
+    B = 8
+    hist_g = np.array([1.0, -30.0, 2.0, 1.5, 0, 0, 0, 0])
+    hist_h = np.array([5.0, 10.0, 5.0, 5.0, 0, 0, 0, 0])
+    hist_c = np.array([10, 20, 10, 10, 0, 0, 0, 0])
+    params = SplitParams(0.0, 0.0, 0.0, 1.0, 1e-3, True)
+    meta = FeatureMeta(num_bin=jnp.asarray([4], jnp.int32),
+                       default_bin=jnp.asarray([0], jnp.int32),
+                       is_categorical=jnp.asarray([True]))
+    hist = jnp.asarray(np.stack([hist_g, hist_h, hist_c], -1)[None], jnp.float32)
+    out = np.asarray(find_best_split(hist, hist_g.sum(), hist_h.sum(),
+                                     hist_c.sum(), meta, jnp.asarray([True]),
+                                     params))
+    assert int(out[THRESHOLD]) == 1   # isolate category bin 1
+    lg = hist_g[1]
+    lh = hist_h[1]
+    assert out[LEFT_OUTPUT] == pytest.approx(-lg / (lh + kEps), rel=1e-4)
+
+
+def test_feature_tiebreak_prefers_smaller_index():
+    # two identical features -> argmax picks feature 0
+    hist_g = np.array([5.0, -5.0, 0, 0])
+    hist_h = np.array([3.0, 3.0, 0, 0])
+    hist_c = np.array([10, 10, 0, 0])
+    one = np.stack([hist_g, hist_h, hist_c], -1)
+    hist = jnp.asarray(np.stack([one, one]), jnp.float32)
+    params = SplitParams(0.0, 0.0, 0.0, 1.0, 1e-3, True)
+    meta = FeatureMeta(num_bin=jnp.asarray([2, 2], jnp.int32),
+                       default_bin=jnp.asarray([0, 0], jnp.int32),
+                       is_categorical=jnp.asarray([False, False]))
+    out = np.asarray(find_best_split(hist, 0.0, 6.0, 20.0, meta,
+                                     jnp.asarray([True, True]), params))
+    assert int(out[FEATURE]) == 0

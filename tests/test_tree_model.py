@@ -1,0 +1,94 @@
+"""Tree model: split bookkeeping, prediction semantics, text round-trip."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.models.tree import Tree
+
+
+def build_small_tree():
+    t = Tree(4)
+    # root split on feature 0, threshold 0.5, zero->left (dbz 0 <= thr bin 1)
+    right = t.split(0, 0, False, 1, 0, 0.5, -1.0, 1.0, 10, 20, 5.0, 0, 0, 0.0)
+    assert right == 1
+    # split right leaf on feature 2, threshold -0.2
+    right2 = t.split(1, 2, False, 3, 2, -0.2, 0.5, 2.0, 8, 12, 3.0, 1, 1, 0.0)
+    assert right2 == 2
+    return t
+
+
+def test_split_structure():
+    t = build_small_tree()
+    assert t.num_leaves == 3
+    assert t.left_child[0] == ~0
+    assert t.right_child[0] == 1       # internal node 1
+    assert t.left_child[1] == ~1
+    assert t.right_child[1] == ~2
+    assert t.leaf_parent[0] == 0
+    assert t.leaf_parent[1] == 1
+    assert t.leaf_parent[2] == 1
+    assert t.internal_count[0] == 30
+
+
+def test_predict_decision_path():
+    t = build_small_tree()
+    X = np.array([
+        [0.4, 0.0, 0.0],    # f0<=0.5 -> leaf0 (-1.0)
+        [0.6, 0.0, -0.5],   # f0>0.5, f2<=-0.2 -> leaf1 (0.5)
+        [0.6, 0.0, 0.3],    # f0>0.5, f2>-0.2 -> leaf2 (2.0)
+    ])
+    np.testing.assert_allclose(t.predict(X), [-1.0, 0.5, 2.0])
+
+
+def test_zero_default_redirect():
+    t = Tree(2)
+    # threshold 0.5 but zero-values redirect to default_value 1.0 (-> right)
+    t.split(0, 0, False, 1, 0, 0.5, -1.0, 1.0, 10, 20, 5.0, 0, 2, 1.0)
+    X = np.array([[0.0], [1e-21], [0.3]])
+    out = t.predict(X)
+    assert out[0] == 1.0    # zero redirected to 1.0 > 0.5 -> right
+    assert out[1] == 1.0
+    assert out[2] == -1.0
+
+
+def test_shrinkage_clamp():
+    t = build_small_tree()
+    t.leaf_value[0] = 5000.0
+    t.shrink(0.1)
+    assert t.leaf_value[0] == 100.0  # kMaxTreeOutput clamp (tree.h:110-118)
+    assert t.shrinkage == pytest.approx(0.1)
+
+
+def test_text_roundtrip_exact():
+    t = build_small_tree()
+    t.shrink(0.1)
+    s = t.to_string()
+    t2 = Tree.from_string(s)
+    assert t2.num_leaves == t.num_leaves
+    np.testing.assert_array_equal(t2.left_child[:2], t.left_child[:2])
+    np.testing.assert_array_equal(t2.right_child[:2], t.right_child[:2])
+    np.testing.assert_array_equal(t2.split_feature[:2], t.split_feature[:2])
+    np.testing.assert_allclose(t2.threshold[:2], t.threshold[:2])
+    np.testing.assert_allclose(t2.leaf_value[:3], t.leaf_value[:3])
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50, 3))
+    np.testing.assert_allclose(t2.predict(X), t.predict(X))
+
+
+def test_field_order_matches_reference_format():
+    t = build_small_tree()
+    lines = [l.split("=")[0] for l in t.to_string().splitlines() if "=" in l]
+    assert lines == ["num_leaves", "split_feature", "split_gain", "threshold",
+                     "decision_type", "default_value", "left_child",
+                     "right_child", "leaf_parent", "leaf_value", "leaf_count",
+                     "internal_value", "internal_count", "shrinkage",
+                     "has_categorical"]
+
+
+def test_categorical_decision():
+    t = Tree(2)
+    t.split(0, 0, True, 2, 0, 7.0, -1.0, 1.0, 10, 20, 5.0, 0, 0, 0.0)
+    X = np.array([[7.0], [7.4], [3.0]])
+    out = t.predict(X)
+    assert out[0] == -1.0   # int(7.0) == 7 -> left
+    assert out[1] == -1.0   # int cast truncates
+    assert out[2] == 1.0
