@@ -1,0 +1,149 @@
+"""Command-line application — parity with src/application/application.cpp.
+
+Usage:  python -m lightgbm_tpu config=train.conf [key=value ...]
+CLI args override the config file (application.cpp:48-104).  Tasks: train,
+predict (convert_model is accepted and routed to the JSON dump for now).
+Snapshots every ``snapshot_freq`` iterations (application.cpp:237-241).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train as train_api
+from .metrics import create_metric
+from .models.factory import create_boosting
+from .objectives import create_objective
+from .io.dataset import TrainingData
+from .io import parser as _parser
+from .utils.config import Config, key_alias_transform
+from .utils.log import Log
+
+
+def parse_cli_params(argv: List[str]) -> Dict[str, str]:
+    """config= file + k=v overrides; CLI wins (application.cpp:48-104)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            Log.warning("Unknown argument: %s", arg)
+            continue
+        k, _, v = arg.partition("=")
+        cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    conf_path = cli.get("config") or cli.get("config_file")
+    if conf_path:
+        with open(conf_path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, _, v = line.partition("=")
+                params.setdefault(k.strip(), v.strip())
+    params.update(cli)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+def run_train(cfg: Config) -> None:
+    if not cfg.data:
+        Log.fatal("No training data, application quit")
+    Log.info("Loading train data...")
+    train_td = TrainingData.from_file(cfg.data, cfg)
+    objective = create_objective(cfg.objective, cfg)
+    if objective is not None:
+        objective.init(train_td.metadata, train_td.num_data)
+    training_metrics = []
+    if cfg.is_training_metric:
+        for name in cfg.metrics():
+            m = create_metric(name, cfg)
+            if m is not None:
+                m.init(train_td.metadata, train_td.num_data)
+                training_metrics.append(m)
+    booster = create_boosting(cfg.boosting_type, cfg, train_td, objective,
+                              training_metrics)
+    if cfg.input_model:
+        with open(cfg.input_model) as f:
+            base = f.read()
+        Log.info("Continued training from %s", cfg.input_model)
+        booster.load_model_from_string(base)
+        booster.reset_training_data(cfg, train_td, objective, training_metrics)
+    for i, vf in enumerate(cfg.valid_data or []):
+        Log.info("Loading validation data %d...", i + 1)
+        valid_td = TrainingData.from_file(vf, cfg, reference=train_td)
+        metrics = []
+        for name in cfg.metrics():
+            m = create_metric(name, cfg)
+            if m is not None:
+                m.init(valid_td.metadata, valid_td.num_data)
+                metrics.append(m)
+        booster.add_valid_dataset(valid_td, metrics)
+    Log.info("Started training...")
+    import time
+    for it in range(cfg.num_iterations):
+        t0 = time.time()
+        stop = booster.train_one_iter(None, None, True)
+        Log.info("%f seconds elapsed, finished iteration %d",
+                 time.time() - t0, it + 1)
+        if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
+            booster.save_model_to_file("%s.snapshot_iter_%d"
+                                       % (cfg.output_model, it + 1))
+        if stop:
+            break
+    booster.save_model_to_file(cfg.output_model)
+    Log.info("Finished training")
+
+
+def run_predict(cfg: Config) -> None:
+    if not cfg.data:
+        Log.fatal("No prediction data, application quit")
+    with open(cfg.input_model) as f:
+        model_str = f.read()
+    booster = Booster(model_str=model_str)
+    parsed = _parser.parse_file(cfg.data, has_header=cfg.has_header)
+    num_iteration = cfg.num_iteration_predict
+    out = booster.predict(parsed.features, num_iteration=num_iteration,
+                          raw_score=cfg.is_predict_raw_score,
+                          pred_leaf=cfg.is_predict_leaf_index)
+    out = np.asarray(out)
+    with open(cfg.output_result, "w") as f:
+        if out.ndim == 1:
+            for v in out:
+                f.write("%.9g\n" % v)
+        else:
+            for row in out:
+                f.write("\t".join("%.9g" % v for v in row) + "\n")
+    Log.info("Finished prediction, results saved to %s", cfg.output_result)
+
+
+def run_convert_model(cfg: Config) -> None:
+    with open(cfg.input_model) as f:
+        booster = Booster(model_str=f.read())
+    import json
+    with open(cfg.convert_model, "w") as f:
+        f.write(booster._gbdt.dump_model())
+    Log.info("Model dumped to %s", cfg.convert_model)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_cli_params(argv)
+    params = key_alias_transform(params, raise_unknown=False)
+    cfg = Config(params)
+    task = params.get("task", "train")
+    if task == "train":
+        run_train(cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(cfg)
+    elif task == "convert_model":
+        run_convert_model(cfg)
+    else:
+        Log.fatal("Unknown task: %s", task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
