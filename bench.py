@@ -1,0 +1,75 @@
+"""Benchmark: boosting iters/sec on a Higgs-like 1M x 28 binary workload.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors the reference's GPU benchmark recipe
+(docs/GPU-Performance.md:84-117): num_leaves=63, max_bin=63, lr=0.1, binary
+objective.  Data is a deterministic synthetic stand-in for Higgs (the real
+10.5M x 28 set isn't shipped in-repo); the SAME data/config was run through
+the reference CLI (built from /root/reference) on this host's CPU to set
+BASELINE_ITERS_PER_SEC.
+
+Run on whatever `jax.devices()` offers (the real TPU chip under the driver).
+"""
+import json
+import time
+
+import numpy as np
+
+# Reference CLI built from /root/reference, same data + config, this host's
+# CPU (1 core), measured 2026-07-29: 5.087 s/iter.  See BENCH_NOTES.md.
+BASELINE_ITERS_PER_SEC = 0.197
+
+N_ROWS = 1_000_000
+N_FEATURES = 28
+WARMUP = 5
+MEASURED = 20
+
+
+def make_data():
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    w = rng.normal(size=N_FEATURES) * (rng.random(N_FEATURES) > 0.3)
+    logit = X @ w * 0.5 + 0.5 * rng.normal(size=N_ROWS)
+    y = (logit > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    import jax
+    import lightgbm_tpu as lgb
+
+    X, y = make_data()
+    params = {"objective": "binary", "num_leaves": 63, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1,
+              "metric": "auc"}
+    train_set = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=train_set)
+    gbdt = bst._gbdt
+
+    # warmup (compile)
+    for _ in range(WARMUP):
+        gbdt.train_one_iter(None, None, False)
+    jax.block_until_ready(gbdt._score_dev)
+
+    t0 = time.time()
+    for _ in range(MEASURED):
+        gbdt.train_one_iter(None, None, False)
+    jax.block_until_ready(gbdt._score_dev)
+    dt = time.time() - t0
+    ips = MEASURED / dt
+
+    # sanity: training must actually be learning
+    auc = gbdt.get_eval_at(0)[0]
+    assert auc > 0.7, "benchmark model failed to learn (auc=%.3f)" % auc
+
+    print(json.dumps({
+        "metric": "boosting_iters_per_sec_1Mx28_63leaves_63bins",
+        "value": round(ips, 3),
+        "unit": "iters/sec",
+        "vs_baseline": round(ips / BASELINE_ITERS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

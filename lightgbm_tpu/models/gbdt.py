@@ -108,7 +108,8 @@ class GBDT:
             self.is_constant_hessian = objective.is_constant_hessian()
         self.train_data = train_data
         self.num_data = train_data.num_data
-        self.learner = SerialTreeLearner(config, train_data)
+        from ..parallel.mesh import create_tree_learner
+        self.learner = create_tree_learner(config, train_data)
         self.score_dtype = self.learner.dtype
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = train_data.num_total_features - 1

@@ -91,6 +91,10 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         hess = hess.astype(hist_dtype)
         row_mult = row_mult.astype(hist_dtype)
         leaf_id = jnp.zeros(n, dtype=jnp.int32)
+        if psum_axis is not None:
+            # under shard_map the row->leaf map is shard-varying from the
+            # first split on; mark the initial carry accordingly (VMA rules)
+            leaf_id = lax.pvary(leaf_id, (psum_axis,))
 
         root_sums = maybe_psum(jnp.stack([
             jnp.sum(grad * row_mult), jnp.sum(hess * row_mult),

@@ -1,0 +1,5 @@
+from .mesh import (DataParallelTreeLearner, create_tree_learner,
+                   make_data_mesh, DATA_AXIS)
+
+__all__ = ["DataParallelTreeLearner", "create_tree_learner",
+           "make_data_mesh", "DATA_AXIS"]

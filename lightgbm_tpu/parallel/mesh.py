@@ -1,0 +1,151 @@
+"""Distributed tree learning over a device mesh — the Network layer reborn.
+
+The reference distributes with a socket/MPI Allreduce stack
+(src/network/network.cpp:23-185, linkers_socket.cpp) and three learner
+subclasses (feature/data/voting parallel, src/treelearner/
+*_parallel_tree_learner.cpp).  TPU-native, the whole Network layer collapses
+into XLA collectives over an ICI mesh:
+
+* data-parallel  — rows sharded, histograms psum'd inside the grow program
+  (`lax.psum` == ReduceScatter+Allgather of HistogramBinEntry sums,
+  data_parallel_tree_learner.cpp:148-222);
+* feature-parallel — all rows everywhere, features sharded; only the best
+  SplitInfo crosses devices (an argmax-reduce of the packed split vector,
+  feature_parallel_tree_learner.cpp:52-76);
+* voting-parallel — data-parallel with top-k histogram exchange
+  (voting_parallel_tree_learner.cpp); on ICI bandwidth the full psum is
+  usually faster, so voting maps to the data-parallel path (kept as a
+  config alias; a true top-k exchange is a DCN-scale optimization).
+
+Multi-host: `jax.distributed.initialize` + the same mesh spanning all
+processes replaces machine_list_file/port handshakes (linkers_socket.cpp).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..io.dataset import TrainingData
+from ..ops.grow import make_grow_fn
+from ..ops.learner import SerialTreeLearner, build_split_params
+from ..ops.split_finder import FeatureMeta
+from ..utils.config import Config
+from ..utils.log import Log
+
+DATA_AXIS = "data"
+
+
+def make_data_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def pad_rows(n: int, num_shards: int) -> int:
+    """Rows padded so each shard holds the same count (XLA static shapes)."""
+    return (-n) % num_shards
+
+
+class DataParallelTreeLearner(SerialTreeLearner):
+    """Row-sharded learner; one psum per histogram construction.
+
+    The same grow program as the serial learner runs under shard_map with
+    `psum_axis='data'`: per-leaf histograms and root sums are all-reduced so
+    every shard sees identical split decisions and applies them to its local
+    rows — the lock-step SPMD structure of the reference's data-parallel
+    loop (SURVEY.md §3.5) with XLA supplying the ring reductions.
+    """
+
+    def __init__(self, config: Config, train_data: TrainingData,
+                 mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_data_mesh()
+        n_shards = self.mesh.devices.size
+        n = train_data.num_data
+        pad = pad_rows(n, n_shards)
+        self._pad = pad
+        binned = train_data.binned
+        if pad:
+            binned = np.concatenate(
+                [binned, np.zeros((pad, binned.shape[1]), binned.dtype)])
+        x_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        X_dev = jax.device_put(binned, x_sharding)
+        super().__init__(config, train_data, psum_axis=DATA_AXIS,
+                         device_data=X_dev)
+        self._row_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._ones = jax.device_put(
+            np.concatenate([np.ones(n, np.float32),
+                            np.zeros(pad, np.float32)]).astype(self.dtype),
+            self._row_sharding)
+        grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
+                            self.params, config.max_depth,
+                            hist_mode="scatter", hist_dtype=self.dtype,
+                            psum_axis=DATA_AXIS)
+        try:
+            sharded_grow = shard_map(
+                grow, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P()),
+                out_specs=(jax.tree_util.tree_map(lambda _: P(),
+                                                  self._dummy_tree_spec()),
+                           P(DATA_AXIS)))
+        except TypeError:
+            sharded_grow = shard_map(
+                grow, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P()),
+                out_specs=(jax.tree_util.tree_map(lambda _: P(),
+                                                  self._dummy_tree_spec()),
+                           P(DATA_AXIS)),
+                check_rep=False)
+        self._grow = jax.jit(sharded_grow)
+        Log.info("Data-parallel learner over %d devices (%d padded rows)",
+                 n_shards, pad)
+
+    def _dummy_tree_spec(self):
+        # a TreeArrays-shaped pytree of None leaves for out_specs mapping
+        from ..ops.grow import TreeArrays
+        return TreeArrays(*([0] * len(TreeArrays._fields)))
+
+    def _pad_rows_dev(self, arr, fill=0.0):
+        arr = jnp.asarray(arr, self.dtype)
+        if self._pad:
+            arr = jnp.concatenate(
+                [arr, jnp.full((self._pad,), fill, self.dtype)])
+        return jax.device_put(arr, self._row_sharding)
+
+    def train_device(self, grad, hess, row_mult=None, feature_mask=None):
+        grad = self._pad_rows_dev(grad)
+        hess = self._pad_rows_dev(hess)
+        if row_mult is None:
+            row_mult = self._ones
+        else:
+            row_mult = self._pad_rows_dev(row_mult)
+        if feature_mask is None:
+            feature_mask = self.sample_feature_mask()
+        tree, leaf_id = self._grow(self.X, grad, hess, row_mult, feature_mask)
+        return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id
+
+
+def create_tree_learner(config: Config, train_data: TrainingData,
+                        mesh: Optional[Mesh] = None):
+    """TreeLearner::CreateTreeLearner (tree_learner.h:19-82) — learner type
+    x device dispatch.  'serial' on one device; 'data'/'feature'/'voting'
+    over the mesh ('feature' currently routes to data-parallel: with rows
+    sharded the search is already feature-complete per shard; a dedicated
+    feature-sharded search is tracked for wide datasets)."""
+    ltype = config.tree_learner
+    n_dev = len(jax.devices()) if mesh is None else mesh.devices.size
+    if ltype in ("data", "feature", "voting", "data_parallel",
+                 "feature_parallel", "voting_parallel") and n_dev > 1:
+        return DataParallelTreeLearner(config, train_data, mesh)
+    if ltype not in ("serial", "data", "feature", "voting", "data_parallel",
+                     "feature_parallel", "voting_parallel"):
+        Log.fatal("Unknown tree learner type %s", ltype)
+    return SerialTreeLearner(config, train_data)

@@ -1,0 +1,79 @@
+"""Multi-device data-parallel training on the virtual 8-device CPU mesh —
+the reference's OpenCL-on-CPU / single-process-MPI trick (SURVEY.md §4)."""
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.parallel.mesh import (DataParallelTreeLearner,
+                                        make_data_mesh)
+from lightgbm_tpu.ops.learner import SerialTreeLearner
+from lightgbm_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    n, f = 1003, 8   # deliberately not divisible by 8 (padding path)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_data_parallel_tree_matches_serial(data):
+    X, y = data
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    g = (1.0 / (1.0 + np.exp(-np.zeros(len(y)))) - y).astype(np.float32)
+    h = np.full(len(y), 0.25, dtype=np.float32)
+
+    serial = SerialTreeLearner(cfg, td)
+    tree_s, leaf_s = serial.train(g, h)
+
+    mesh = make_data_mesh(jax.devices())
+    dp = DataParallelTreeLearner(cfg, td, mesh)
+    tree_dev, leaf_d = dp.train_device(g, h)
+    tree_d = dp.materialize(tree_dev)
+
+    # identical structure and outputs (psum changes reduction order, so
+    # float32 sums can differ in the last ulps -> identical splits expected
+    # on well-separated gains)
+    assert tree_d.num_leaves == tree_s.num_leaves
+    np.testing.assert_array_equal(tree_d.split_feature[:tree_d.num_leaves - 1],
+                                  tree_s.split_feature[:tree_s.num_leaves - 1])
+    np.testing.assert_array_equal(tree_d.threshold_in_bin[:tree_d.num_leaves - 1],
+                                  tree_s.threshold_in_bin[:tree_s.num_leaves - 1])
+    np.testing.assert_allclose(tree_d.leaf_value[:tree_d.num_leaves],
+                               tree_s.leaf_value[:tree_s.num_leaves],
+                               rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(leaf_d), np.asarray(leaf_s))
+
+
+def test_end_to_end_data_parallel_training(data):
+    X, y = data
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "tree_learner": "data", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    train, num_boost_round=20, valid_sets=[train],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["training"]["auc"][-1] > 0.97
+    p = bst.predict(X)
+    assert (((p > 0.5) == (y > 0)).mean()) > 0.9
+
+
+def test_voting_alias_and_feature_alias(data):
+    X, y = data
+    for ltype in ("feature", "voting"):
+        train = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "tree_learner": ltype,
+                         "verbose": -1, "num_leaves": 7,
+                         "min_data_in_leaf": 5},
+                        train, num_boost_round=5, verbose_eval=False)
+        assert bst.num_trees() > 0
