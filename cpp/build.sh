@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native data plane into lightgbm_tpu/lib/.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+cd build
+cmake .. -DCMAKE_BUILD_TYPE=Release "$@"
+cmake --build . -j"$(nproc)"

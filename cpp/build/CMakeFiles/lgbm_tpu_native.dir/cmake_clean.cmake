@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/lightgbm_tpu/lib/liblgbm_tpu_native.pdb"
+  "/root/repo/lightgbm_tpu/lib/liblgbm_tpu_native.so"
+  "CMakeFiles/lgbm_tpu_native.dir/src/native.cpp.o"
+  "CMakeFiles/lgbm_tpu_native.dir/src/native.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/lgbm_tpu_native.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

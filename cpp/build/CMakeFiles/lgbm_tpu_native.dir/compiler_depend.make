@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for lgbm_tpu_native.
+# This may be replaced when dependencies are built.

@@ -138,6 +138,21 @@ class BinMapper:
         # NaNs: this reference line treats only the zero-range as missing and
         # its parser never produces NaN; map them to zero for robustness.
         values = values[~np.isnan(values)]
+
+        if bin_type == NUMERICAL:
+            from .. import native
+            res = native.find_bin_numerical(values, total_sample_cnt, max_bin,
+                                            min_data_in_bin, min_split_data)
+            if res is not None:
+                (bounds, trivial, vmin, vmax, default_bin, sparse_rate) = res
+                self.bin_upper_bound = bounds
+                self.num_bin = len(bounds)
+                self.is_trivial = trivial
+                self.min_val = vmin
+                self.max_val = vmax
+                self.default_bin = default_bin
+                self.sparse_rate = sparse_rate
+                return
         num_sample_values = len(values)
         zero_cnt = int(total_sample_cnt - num_sample_values)
         values = np.sort(values, kind="stable")

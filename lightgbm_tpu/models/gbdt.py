@@ -527,8 +527,13 @@ class GBDT:
         features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
         n = features.shape[0]
         k = self.num_tree_per_iteration
-        out = np.zeros((n, k), dtype=np.float64)
         num_used = self._used_trees(num_iteration)
+        from .. import native
+        nat = native.predict_raw(
+            [(self.models[t], t % k) for t in range(num_used)], k, features)
+        if nat is not None:
+            return nat
+        out = np.zeros((n, k), dtype=np.float64)
         for t in range(num_used):
             out[:, t % k] += self.models[t].predict(features)
         return out

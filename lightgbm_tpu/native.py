@@ -1,0 +1,174 @@
+"""ctypes bridge to the native data plane (cpp/src/native.cpp).
+
+Mirrors the reference's ctypes loading pattern (python-package basic.py:21 +
+libpath.py) — the library is optional: every call site has a pure-Python
+fallback, so the package works before `cpp/build.sh` has run.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_c_double_p = ctypes.POINTER(ctypes.c_double)
+
+
+def find_lib_path() -> Optional[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    for cand in (os.path.join(here, "lib", "liblgbm_tpu_native.so"),
+                 os.path.join(here, "..", "cpp", "build",
+                              "liblgbm_tpu_native.so")):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = find_lib_path()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.LGBMTPU_FindBinNumerical.restype = ctypes.c_int
+        lib.LGBMTPU_ValueToBin.restype = ctypes.c_int
+        lib.LGBMTPU_ParseFile.restype = ctypes.c_int
+        lib.LGBMTPU_PredictRaw.restype = ctypes.c_int
+        lib.LGBMTPU_Free.restype = None
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _np_ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def find_bin_numerical(values: np.ndarray, total_cnt: int, max_bin: int,
+                       min_data_in_bin: int, min_split_data: int):
+    """Native FindBin; returns (upper_bounds, is_trivial, min_val, max_val,
+    default_bin, sparse_rate) or None when the lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    out_bounds = np.empty(max(max_bin, 2), dtype=np.float64)
+    num_bin = ctypes.c_int32()
+    trivial = ctypes.c_int32()
+    vmin = ctypes.c_double()
+    vmax = ctypes.c_double()
+    default_bin = ctypes.c_int32()
+    sparse_rate = ctypes.c_double()
+    rc = lib.LGBMTPU_FindBinNumerical(
+        _np_ptr(values, ctypes.c_double), ctypes.c_int32(len(values)),
+        ctypes.c_int32(total_cnt), ctypes.c_int32(max_bin),
+        ctypes.c_int32(min_data_in_bin), ctypes.c_int32(min_split_data),
+        _np_ptr(out_bounds, ctypes.c_double), ctypes.byref(num_bin),
+        ctypes.byref(trivial), ctypes.byref(vmin), ctypes.byref(vmax),
+        ctypes.byref(default_bin), ctypes.byref(sparse_rate))
+    if rc != 0:
+        return None
+    return (out_bounds[:num_bin.value].copy(), bool(trivial.value),
+            vmin.value, vmax.value, default_bin.value, sparse_rate.value)
+
+
+def value_to_bin(upper_bounds: np.ndarray, values: np.ndarray):
+    lib = get_lib()
+    if lib is None:
+        return None
+    upper_bounds = np.ascontiguousarray(upper_bounds, dtype=np.float64)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    out = np.empty(values.size, dtype=np.uint16)
+    rc = lib.LGBMTPU_ValueToBin(
+        _np_ptr(upper_bounds, ctypes.c_double),
+        ctypes.c_int32(len(upper_bounds)),
+        _np_ptr(values, ctypes.c_double), ctypes.c_int64(values.size),
+        _np_ptr(out, ctypes.c_uint16))
+    if rc != 0:
+        return None
+    return out.reshape(values.shape).astype(np.int64)
+
+
+def parse_file(path: str, has_header: bool, label_idx: int):
+    """Native file parse -> (features, label) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int32()
+    feat_p = _c_double_p()
+    lab_p = _c_double_p()
+    rc = lib.LGBMTPU_ParseFile(path.encode(), ctypes.c_int32(int(has_header)),
+                               ctypes.c_int32(label_idx), ctypes.byref(rows),
+                               ctypes.byref(cols), ctypes.byref(feat_p),
+                               ctypes.byref(lab_p))
+    if rc != 0:
+        return None
+    n, f = rows.value, cols.value
+    feat = np.ctypeslib.as_array(feat_p, shape=(n, f)).copy()
+    lab = np.ctypeslib.as_array(lab_p, shape=(n,)).copy()
+    lib.LGBMTPU_Free(feat_p)
+    lib.LGBMTPU_Free(lab_p)
+    return feat, lab
+
+
+def predict_raw(trees, n_class: int, features: np.ndarray) -> Optional[np.ndarray]:
+    """Ensemble prediction through the native traversal.
+
+    trees: list of (models.Tree, class_id).
+    """
+    lib = get_lib()
+    if lib is None or not trees:
+        return None
+    node_offsets = [0]
+    leaf_offsets = [0]
+    sf, th, dt, dv, lc, rc_, lv, tc = [], [], [], [], [], [], [], []
+    for tree, cls in trees:
+        ni = max(tree.num_leaves - 1, 0)
+        nl = tree.num_leaves
+        sf.append(tree.split_feature[:ni])
+        th.append(tree.threshold[:ni])
+        dt.append(tree.decision_type[:ni])
+        dv.append(tree.default_value[:ni])
+        lc.append(tree.left_child[:ni])
+        rc_.append(tree.right_child[:ni])
+        lv.append(tree.leaf_value[:nl])
+        tc.append(cls)
+        node_offsets.append(node_offsets[-1] + ni)
+        leaf_offsets.append(leaf_offsets[-1] + nl)
+    features = np.ascontiguousarray(features, dtype=np.float64)
+    n, f = features.shape
+    out = np.zeros((n, n_class), dtype=np.float64)
+    cat = lambda arrs, dtype: np.ascontiguousarray(
+        np.concatenate(arrs) if arrs else np.empty(0), dtype=dtype)
+    rc = lib.LGBMTPU_PredictRaw(
+        ctypes.c_int32(len(trees)),
+        _np_ptr(np.asarray(node_offsets, np.int64), ctypes.c_int64),
+        _np_ptr(np.asarray(leaf_offsets, np.int64), ctypes.c_int64),
+        _np_ptr(cat(sf, np.int32), ctypes.c_int32),
+        _np_ptr(cat(th, np.float64), ctypes.c_double),
+        _np_ptr(cat(dt, np.int8), ctypes.c_int8),
+        _np_ptr(cat(dv, np.float64), ctypes.c_double),
+        _np_ptr(cat(lc, np.int32), ctypes.c_int32),
+        _np_ptr(cat(rc_, np.int32), ctypes.c_int32),
+        _np_ptr(cat(lv, np.float64), ctypes.c_double),
+        _np_ptr(np.asarray(tc, np.int32), ctypes.c_int32),
+        ctypes.c_int32(n_class),
+        _np_ptr(features, ctypes.c_double), ctypes.c_int64(n),
+        ctypes.c_int32(f), _np_ptr(out, ctypes.c_double))
+    if rc != 0:
+        return None
+    return out
