@@ -1,0 +1,236 @@
+"""LGBM_*-shaped stable API surface — handle-based, mirrors
+include/LightGBM/c_api.h:37-719.
+
+The reference's C API is the ABI every binding goes through; here the same
+function names/shapes operate on an in-process handle registry so code (and
+tests) written against the C API style — dataset from file/mat, push fields,
+booster create/update/eval/predict, model save/load — ports over directly
+(tests/c_api_test/test.py is the model).  Arguments that were raw C pointers
+take numpy arrays.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .utils.config import key_alias_transform
+from .utils.log import LightGBMError
+
+_handles: Dict[int, Any] = {}
+_next_handle = itertools.count(1)
+
+
+def _register(obj) -> int:
+    h = next(_next_handle)
+    _handles[h] = obj
+    return h
+
+
+def _get(handle: int):
+    if handle not in _handles:
+        raise LightGBMError("Invalid handle %s" % handle)
+    return _handles[handle]
+
+
+def _parse_params(parameters: str) -> dict:
+    out = {}
+    for tok in (parameters or "").split():
+        if "=" in tok:
+            k, _, v = tok.partition("=")
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------- datasets
+
+def LGBM_DatasetCreateFromFile(filename: str, parameters: str = "",
+                               reference: Optional[int] = None) -> int:
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, params=params, reference=ref)
+    ds.construct()
+    return _register(ds)
+
+
+def LGBM_DatasetCreateFromMat(data, parameters: str = "",
+                              reference: Optional[int] = None,
+                              label=None) -> int:
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.asarray(data, dtype=np.float64), label=label,
+                 params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    return _register(ds)
+
+
+def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col: int,
+                              parameters: str = "",
+                              reference: Optional[int] = None) -> int:
+    n = len(indptr) - 1
+    mat = np.zeros((n, num_col), dtype=np.float64)
+    for r in range(n):
+        for j in range(indptr[r], indptr[r + 1]):
+            mat[r, indices[j]] = data[j]
+    return LGBM_DatasetCreateFromMat(mat, parameters, reference)
+
+
+def LGBM_DatasetCreateFromCSC(colptr, indices, data, num_row: int,
+                              parameters: str = "",
+                              reference: Optional[int] = None) -> int:
+    num_col = len(colptr) - 1
+    mat = np.zeros((num_row, num_col), dtype=np.float64)
+    for c in range(num_col):
+        for j in range(colptr[c], colptr[c + 1]):
+            mat[indices[j], c] = data[j]
+    return LGBM_DatasetCreateFromMat(mat, parameters, reference)
+
+
+def LGBM_DatasetSetField(handle: int, field_name: str, data) -> int:
+    _get(handle).set_field(field_name, data)
+    return 0
+
+
+def LGBM_DatasetGetField(handle: int, field_name: str):
+    return _get(handle).get_field(field_name)
+
+
+def LGBM_DatasetGetNumData(handle: int) -> int:
+    return _get(handle).num_data()
+
+
+def LGBM_DatasetGetNumFeature(handle: int) -> int:
+    return _get(handle).num_feature()
+
+
+def LGBM_DatasetSaveBinary(handle: int, filename: str) -> int:
+    _get(handle).save_binary(filename)
+    return 0
+
+
+def LGBM_DatasetFree(handle: int) -> int:
+    _handles.pop(handle, None)
+    return 0
+
+
+# ---------------------------------------------------------------- boosters
+
+def LGBM_BoosterCreate(train_data: int, parameters: str = "") -> int:
+    params = _parse_params(parameters)
+    bst = Booster(params=params, train_set=_get(train_data))
+    return _register(bst)
+
+
+def LGBM_BoosterCreateFromModelfile(filename: str) -> int:
+    return _register(Booster(model_file=filename))
+
+
+def LGBM_BoosterLoadModelFromString(model_str: str) -> int:
+    return _register(Booster(model_str=model_str))
+
+
+def LGBM_BoosterAddValidData(handle: int, valid_data: int) -> int:
+    bst = _get(handle)
+    bst.add_valid(_get(valid_data), "valid_%d" % len(bst.name_valid_sets))
+    return 0
+
+
+def LGBM_BoosterUpdateOneIter(handle: int) -> int:
+    """Returns 1 when training should stop (c_api.cpp:149 semantics)."""
+    return int(_get(handle).update())
+
+
+def LGBM_BoosterUpdateOneIterCustom(handle: int, grad, hess) -> int:
+    bst = _get(handle)
+    return int(bst._gbdt.train_one_iter(np.asarray(grad, np.float32),
+                                        np.asarray(hess, np.float32), False))
+
+
+def LGBM_BoosterRollbackOneIter(handle: int) -> int:
+    _get(handle).rollback_one_iter()
+    return 0
+
+
+def LGBM_BoosterGetCurrentIteration(handle: int) -> int:
+    return _get(handle).current_iteration()
+
+
+def LGBM_BoosterGetEval(handle: int, data_idx: int) -> List[float]:
+    return _get(handle)._gbdt.get_eval_at(data_idx)
+
+
+def LGBM_BoosterGetEvalNames(handle: int) -> List[str]:
+    return _get(handle)._gbdt.eval_names(0)
+
+
+def LGBM_BoosterGetNumClasses(handle: int) -> int:
+    return _get(handle)._gbdt.num_class
+
+
+def LGBM_BoosterPredictForMat(handle: int, data, predict_type: int = 0,
+                              num_iteration: int = -1):
+    """predict_type: 0 normal, 1 raw score, 2 leaf index (c_api.h)."""
+    bst = _get(handle)
+    return bst.predict(np.asarray(data, dtype=np.float64),
+                       num_iteration=num_iteration,
+                       raw_score=predict_type == 1,
+                       pred_leaf=predict_type == 2)
+
+
+def LGBM_BoosterPredictForFile(handle: int, data_filename: str,
+                               data_has_header: bool, result_filename: str,
+                               predict_type: int = 0,
+                               num_iteration: int = -1) -> int:
+    bst = _get(handle)
+    out = bst.predict(data_filename, data_has_header=data_has_header,
+                      num_iteration=num_iteration,
+                      raw_score=predict_type == 1,
+                      pred_leaf=predict_type == 2)
+    out = np.asarray(out)
+    with open(result_filename, "w") as f:
+        if out.ndim == 1:
+            for v in out:
+                f.write("%.9g\n" % v)
+        else:
+            for row in out:
+                f.write("\t".join("%.9g" % v for v in row) + "\n")
+    return 0
+
+
+def LGBM_BoosterSaveModel(handle: int, num_iteration: int, filename: str) -> int:
+    _get(handle).save_model(filename, num_iteration=num_iteration)
+    return 0
+
+
+def LGBM_BoosterSaveModelToString(handle: int, num_iteration: int = -1) -> str:
+    return _get(handle).model_to_string(num_iteration=num_iteration)
+
+
+def LGBM_BoosterDumpModel(handle: int, num_iteration: int = -1) -> str:
+    import json
+    return json.dumps(_get(handle).dump_model(num_iteration=num_iteration))
+
+
+def LGBM_BoosterGetLeafValue(handle: int, tree_idx: int, leaf_idx: int) -> float:
+    gbdt = _get(handle)._gbdt
+    gbdt._materialize()
+    return float(gbdt.models[tree_idx].leaf_value[leaf_idx])
+
+
+def LGBM_BoosterSetLeafValue(handle: int, tree_idx: int, leaf_idx: int,
+                             val: float) -> int:
+    gbdt = _get(handle)._gbdt
+    gbdt._materialize()
+    gbdt.models[tree_idx].set_leaf_value(leaf_idx, val)
+    return 0
+
+
+def LGBM_BoosterFeatureImportance(handle: int, num_iteration: int = -1):
+    return _get(handle)._gbdt.feature_importance()
+
+
+def LGBM_BoosterFree(handle: int) -> int:
+    _handles.pop(handle, None)
+    return 0

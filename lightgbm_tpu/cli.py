@@ -52,7 +52,9 @@ def run_train(cfg: Config) -> None:
     if not cfg.data:
         Log.fatal("No training data, application quit")
     Log.info("Loading train data...")
-    train_td = TrainingData.from_file(cfg.data, cfg)
+    # keep raw rows when continuing: loaded models predict on raw values
+    train_td = TrainingData.from_file(cfg.data, cfg,
+                                      keep_raw=bool(cfg.input_model))
     objective = create_objective(cfg.objective, cfg)
     if objective is not None:
         objective.init(train_td.metadata, train_td.num_data)
@@ -120,12 +122,14 @@ def run_predict(cfg: Config) -> None:
 
 
 def run_convert_model(cfg: Config) -> None:
+    """Model -> C++ if-else source (GBDT::SaveModelToIfElse path,
+    application.cpp ConvertModel)."""
+    from .convert_model import model_to_cpp
     with open(cfg.input_model) as f:
         booster = Booster(model_str=f.read())
-    import json
     with open(cfg.convert_model, "w") as f:
-        f.write(booster._gbdt.dump_model())
-    Log.info("Model dumped to %s", cfg.convert_model)
+        f.write(model_to_cpp(booster._gbdt))
+    Log.info("Model converted to %s", cfg.convert_model)
 
 
 def main(argv=None) -> int:

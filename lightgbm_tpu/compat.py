@@ -1,0 +1,33 @@
+"""Optional-dependency shims (python-package/compat.py analog)."""
+from __future__ import annotations
+
+try:
+    import pandas as pd
+    from pandas import DataFrame, Series
+    PANDAS_INSTALLED = True
+except ImportError:
+    PANDAS_INSTALLED = False
+
+    class DataFrame:  # type: ignore
+        pass
+
+    class Series:  # type: ignore
+        pass
+
+try:
+    import sklearn  # noqa: F401
+    SKLEARN_INSTALLED = True
+except ImportError:
+    SKLEARN_INSTALLED = False
+
+try:
+    import matplotlib  # noqa: F401
+    MATPLOTLIB_INSTALLED = True
+except ImportError:
+    MATPLOTLIB_INSTALLED = False
+
+try:
+    import graphviz  # noqa: F401
+    GRAPHVIZ_INSTALLED = True
+except ImportError:
+    GRAPHVIZ_INSTALLED = False

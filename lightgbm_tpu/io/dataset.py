@@ -93,7 +93,8 @@ class TrainingData:
 
     @classmethod
     def from_file(cls, filename: str, config: Optional[Config] = None,
-                  reference: Optional["TrainingData"] = None) -> "TrainingData":
+                  reference: Optional["TrainingData"] = None,
+                  keep_raw: bool = False) -> "TrainingData":
         """CLI/file path (dataset_loader.cpp:159-216): parse, side files,
         label column handling."""
         config = config or Config()
@@ -127,7 +128,7 @@ class TrainingData:
         self = cls.from_matrix(data, label=parsed.label, config=config,
                                categorical_feature=sorted(categorical),
                                feature_names=feature_names,
-                               reference=reference)
+                               reference=reference, keep_raw=keep_raw)
         self.metadata.init_from_file(filename)
         return self
 

@@ -126,12 +126,11 @@ class GBDT:
             score0[:] = np.asarray(init).reshape(k, self.num_data)
         self._score_dev = jnp.asarray(score0, self.score_dtype)
         self._score_host = None
-        # re-apply existing models on (possibly new) training data
+        # re-apply every existing model (incl. loaded/continued ones) on the
+        # (possibly new) training data
         self._materialize()
-        for i in range(self.iter):
-            for tid in range(k):
-                t = (i + self.num_init_iteration) * k + tid
-                self._apply_tree_to_train(self.models[t], tid)
+        for t, tree in enumerate(self.models):
+            self._apply_tree_to_train(tree, t % k)
 
         # degenerate class handling (gbdt.cpp:166-205)
         self.class_need_train = [True] * k

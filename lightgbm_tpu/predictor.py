@@ -1,0 +1,99 @@
+"""Row-wise predictor with prediction early stopping.
+
+Parity targets: src/application/predictor.hpp:24-96 and
+src/boosting/prediction_early_stop.cpp — margin-based stop callbacks
+(binary: 2|margin|, multiclass: top1-top2 gap) checked every
+``round_period`` trees.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .models.gbdt import GBDT
+from .utils.log import Log
+
+
+class PredictionEarlyStopInstance:
+    """(callback, round_period) pair (include/LightGBM/prediction_early_stop.h)."""
+
+    def __init__(self, callback: Callable[[np.ndarray], bool], round_period: int):
+        self.callback = callback
+        self.round_period = round_period
+
+
+def create_prediction_early_stop_instance(type_: str, round_period: int,
+                                          margin_threshold: float
+                                          ) -> PredictionEarlyStopInstance:
+    if type_ == "none":
+        return PredictionEarlyStopInstance(lambda pred: False, 1 << 30)
+    if type_ == "multiclass":
+        def cb_multi(pred):
+            if len(pred) < 2:
+                Log.fatal("Multiclass early stopping needs predictions to be "
+                          "of length two or larger")
+            top2 = np.partition(pred, -2)[-2:]
+            return (top2[1] - top2[0]) > margin_threshold
+        return PredictionEarlyStopInstance(cb_multi, round_period)
+    if type_ == "binary":
+        def cb_binary(pred):
+            if len(pred) != 1:
+                Log.fatal("Binary early stopping needs predictions to be of "
+                          "length one")
+            return 2.0 * abs(pred[0]) > margin_threshold
+        return PredictionEarlyStopInstance(cb_binary, round_period)
+    Log.fatal("Unknown early stopping type: %s", type_)
+
+
+class Predictor:
+    """Per-row predictor (predictor.hpp) honoring pred_early_stop."""
+
+    def __init__(self, gbdt: GBDT, num_iteration: int = -1,
+                 raw_score: bool = False, predict_leaf_index: bool = False,
+                 early_stop: bool = False, early_stop_freq: int = 10,
+                 early_stop_margin: float = 10.0):
+        self.gbdt = gbdt
+        self.num_iteration = num_iteration
+        self.raw_score = raw_score
+        self.predict_leaf_index = predict_leaf_index
+        k = gbdt.num_tree_per_iteration
+        if early_stop and not predict_leaf_index:
+            kind = "multiclass" if k > 1 else "binary"
+            self.early_stop = create_prediction_early_stop_instance(
+                kind, early_stop_freq, early_stop_margin)
+        else:
+            self.early_stop = create_prediction_early_stop_instance(
+                "none", early_stop_freq, early_stop_margin)
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
+        if features.ndim == 1:
+            features = features.reshape(1, -1)
+        if self.predict_leaf_index:
+            return self.gbdt.predict_leaf_index(features, self.num_iteration)
+        gbdt = self.gbdt
+        gbdt._materialize()
+        k = gbdt.num_tree_per_iteration
+        num_used = gbdt._used_trees(self.num_iteration)
+        n = features.shape[0]
+        out = np.zeros((n, k), dtype=np.float64)
+        period = self.early_stop.round_period
+        if period >= num_used:
+            out = gbdt.predict_raw(features, self.num_iteration)
+        else:
+            # per-row early-stopped traversal (predictor.hpp:33-96)
+            for r in range(n):
+                row = features[r:r + 1]
+                pred = np.zeros(k)
+                for t in range(num_used):
+                    pred[t % k] += gbdt.models[t].predict(row)[0]
+                    if (t + 1) % (period * k) == 0 and \
+                            self.early_stop.callback(pred):
+                        break
+                out[r] = pred
+        if self.raw_score or gbdt.objective is None:
+            return out[:, 0] if k == 1 else out
+        conv = np.asarray(gbdt.objective.convert_output(
+            out if k > 1 else out[:, 0]))
+        return conv

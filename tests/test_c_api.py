@@ -1,0 +1,85 @@
+"""C-API-surface smoke test — mirrors tests/c_api_test/test.py flow."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import c_api
+
+
+def make_data(seed=0, n=600, f=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_dataset_roundtrip(tmp_path):
+    X, y = make_data()
+    h = c_api.LGBM_DatasetCreateFromMat(X, "max_bin=63", label=y)
+    assert c_api.LGBM_DatasetGetNumData(h) == 600
+    assert c_api.LGBM_DatasetGetNumFeature(h) == 6
+    c_api.LGBM_DatasetSetField(h, "weight", np.ones(600))
+    w = c_api.LGBM_DatasetGetField(h, "weight")
+    assert len(w) == 600
+    path = str(tmp_path / "ds.bin.npz")
+    c_api.LGBM_DatasetSaveBinary(h, path)
+    h2 = c_api.LGBM_DatasetCreateFromFile(path)
+    assert c_api.LGBM_DatasetGetNumData(h2) == 600
+    c_api.LGBM_DatasetFree(h)
+    c_api.LGBM_DatasetFree(h2)
+
+
+def test_csr_csc():
+    X, y = make_data(n=100)
+    from scipy import sparse as sp
+    csr = sp.csr_matrix(X)
+    h = c_api.LGBM_DatasetCreateFromCSR(csr.indptr, csr.indices, csr.data,
+                                        X.shape[1])
+    assert c_api.LGBM_DatasetGetNumData(h) == 100
+    csc = sp.csc_matrix(X)
+    h2 = c_api.LGBM_DatasetCreateFromCSC(csc.indptr, csc.indices, csc.data,
+                                         X.shape[0])
+    assert c_api.LGBM_DatasetGetNumData(h2) == 100
+
+
+def test_booster_train_eval_predict(tmp_path):
+    X, y = make_data()
+    Xv, yv = make_data(seed=1)
+    train = c_api.LGBM_DatasetCreateFromMat(
+        X, "objective=binary metric=binary_logloss verbose=-1", label=y)
+    valid = c_api.LGBM_DatasetCreateFromMat(
+        Xv, "objective=binary verbose=-1", label=yv,
+        reference=train)
+    bst = c_api.LGBM_BoosterCreate(
+        train, "objective=binary metric=binary_logloss verbose=-1")
+    c_api.LGBM_BoosterAddValidData(bst, valid)
+    for i in range(20):
+        stop = c_api.LGBM_BoosterUpdateOneIter(bst)
+        assert stop == 0
+    assert c_api.LGBM_BoosterGetCurrentIteration(bst) == 20
+    ev = c_api.LGBM_BoosterGetEval(bst, 1)
+    assert len(ev) == 1 and ev[0] < 0.4
+    pred = c_api.LGBM_BoosterPredictForMat(bst, Xv)
+    assert ((pred > 0.5) == (yv > 0)).mean() > 0.9
+    # model save/load parity
+    path = str(tmp_path / "model.txt")
+    c_api.LGBM_BoosterSaveModel(bst, -1, path)
+    bst2 = c_api.LGBM_BoosterCreateFromModelfile(path)
+    pred2 = c_api.LGBM_BoosterPredictForMat(bst2, Xv)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-14)
+    # leaf get/set
+    v = c_api.LGBM_BoosterGetLeafValue(bst, 1, 0)
+    c_api.LGBM_BoosterSetLeafValue(bst, 1, 0, v * 2)
+    assert c_api.LGBM_BoosterGetLeafValue(bst, 1, 0) == pytest.approx(v * 2)
+
+
+def test_custom_update():
+    X, y = make_data()
+    train = c_api.LGBM_DatasetCreateFromMat(X, "verbose=-1", label=y)
+    bst = c_api.LGBM_BoosterCreate(train, "objective=none verbose=-1 num_leaves=15")
+    p = np.zeros(len(y))
+    for _ in range(10):
+        prob = 1.0 / (1.0 + np.exp(-p))
+        c_api.LGBM_BoosterUpdateOneIterCustom(bst, prob - y, prob * (1 - prob))
+        p = c_api.LGBM_BoosterPredictForMat(bst, X, predict_type=1)
+    acc = ((p > 0) == (y > 0)).mean()
+    assert acc > 0.9

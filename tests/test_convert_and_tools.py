@@ -1,0 +1,142 @@
+"""Model conversion tools: C++ if-else codegen (compiled & compared — the
+reference's tests/cpp_test determinism check), PMML, predictor early stop,
+CLI train/predict round trip."""
+import os
+import subprocess
+import ctypes
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.convert_model import model_to_cpp
+from lightgbm_tpu.pmml import model_to_pmml
+from lightgbm_tpu.predictor import Predictor
+
+
+def make_model(tmp_path, n=500, f=5, rounds=8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, f))
+    X[::11, 2] = 0.0   # exercise zero/default paths
+    y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 15},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds,
+                    verbose_eval=False)
+    return bst, X, y
+
+
+def test_cpp_codegen_matches_predictions(tmp_path):
+    """Generate C++ if-else code, compile, and require 5-decimal equality
+    with library predictions (tests/cpp_test/test.py:1-6 semantics)."""
+    bst, X, y = make_model(tmp_path)
+    code = model_to_cpp(bst._gbdt)
+    src = tmp_path / "gen.cpp"
+    src.write_text(code)
+    so = tmp_path / "gen.so"
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src), "-o", str(so)],
+                   check=True)
+    lib = ctypes.CDLL(str(so))
+    lib.LGBMTPU_GenPredictRaw.restype = None
+    out = np.zeros(1)
+    ours = bst.predict(X, raw_score=True)
+    for i in range(0, len(X), 17):
+        row = np.ascontiguousarray(X[i], dtype=np.float64)
+        lib.LGBMTPU_GenPredictRaw(
+            row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        assert round(out[0], 5) == round(ours[i], 5)
+
+
+def test_pmml_output_well_formed(tmp_path):
+    bst, X, y = make_model(tmp_path)
+    xml = model_to_pmml(bst._gbdt)
+    import xml.etree.ElementTree as ET
+    root = ET.fromstring(xml)
+    assert root.tag.endswith("PMML")
+    segs = root.findall(".//{http://www.dmg.org/PMML-4_2}Segment")
+    assert len(segs) == bst.num_trees()
+
+
+def test_predictor_early_stop(tmp_path):
+    bst, X, y = make_model(tmp_path, rounds=40)
+    full = bst.predict(X, raw_score=True)
+    pred = Predictor(bst._gbdt, raw_score=True, early_stop=True,
+                     early_stop_freq=5, early_stop_margin=1.0)
+    stopped = pred.predict(X)
+    # early-stopped margins must agree in sign with the full prediction
+    assert (np.sign(stopped) == np.sign(full)).mean() > 0.95
+
+
+def test_cli_train_predict_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    train_file = tmp_path / "train.tsv"
+    np.savetxt(train_file, np.column_stack([y, X]), fmt="%.6f", delimiter="\t")
+    model_file = tmp_path / "model.txt"
+    result_file = tmp_path / "pred.txt"
+    from lightgbm_tpu.cli import main
+    main(["task=train", "data=%s" % train_file, "objective=binary",
+          "num_trees=5", "verbose=-1", "min_data_in_leaf=5",
+          "output_model=%s" % model_file, "snapshot_freq=-1"])
+    assert model_file.exists()
+    main(["task=predict", "data=%s" % train_file,
+          "input_model=%s" % model_file, "output_result=%s" % result_file])
+    preds = np.loadtxt(result_file)
+    assert len(preds) == 400
+    bst = lgb.Booster(model_file=str(model_file))
+    np.testing.assert_allclose(preds, bst.predict(X), atol=1e-6)
+    # convert_model task
+    gen = tmp_path / "gen.cpp"
+    main(["task=convert_model", "input_model=%s" % model_file,
+          "convert_model=%s" % gen])
+    assert "PredictTree0" in gen.read_text()
+
+
+def test_sklearn_wrappers():
+    from lightgbm_tpu.sklearn import LGBMClassifier, LGBMRegressor, LGBMRanker
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=10, num_leaves=15)
+    clf.fit(X, y)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.9
+    proba = clf.predict_proba(X)
+    assert proba.shape == (400, 2)
+    assert clf.feature_importances_.sum() > 0
+
+    yr = X[:, 0] * 2 + 0.1 * rng.normal(size=400)
+    reg = LGBMRegressor(n_estimators=20, num_leaves=15)
+    reg.fit(X, yr)
+    mse = ((reg.predict(X) - yr) ** 2).mean()
+    assert mse < 0.5
+
+    # 3-class
+    y3 = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    clf3 = LGBMClassifier(n_estimators=10, num_leaves=15)
+    clf3.fit(X, y3)
+    assert clf3.n_classes_ == 3
+    assert clf3.predict_proba(X).shape == (400, 3)
+    assert (clf3.predict(X) == y3).mean() > 0.8
+
+    # ranker
+    yrank = np.clip((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5), 0, 3)
+    rk = LGBMRanker(n_estimators=5, num_leaves=7, min_child_samples=5)
+    rk.fit(X, yrank.astype(float), group=np.full(40, 10))
+    assert rk.booster_.num_trees() > 0
+
+
+def test_sklearn_custom_objective():
+    from lightgbm_tpu.sklearn import LGBMRegressor
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0] * 3
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = LGBMRegressor(n_estimators=30, objective=l2_obj)
+    reg.fit(X, y)
+    pred = reg.predict(X, raw_score=True)
+    assert ((pred - y) ** 2).mean() < 1.0
