@@ -1,0 +1,89 @@
+"""Generate golden cross-compat artifacts with the REFERENCE CLI.
+
+Provenance: the committed files in this directory were produced by this
+script on 2026-07-29, with the reference CLI built unmodified from
+/root/reference (cmake Release).  The *.train/*.test TSV files are
+synthetic (numpy, fixed seeds — authored here, not copied from anywhere);
+the *.model/*.pred files are OUTPUTS of the reference binary on that data.
+
+The parity test (tests/test_model_compat.py) loads each .model with
+lightgbm_tpu and checks predict() against the .pred to float precision —
+proving our text-model reader/writer is bit-compatible with the
+reference's format (gbdt.cpp:817-971).
+
+Usage: python gen_golden.py /path/to/reference-cli-binary
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def write_tsv(path, y, X, qid=None):
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join([repr(float(y[i]))] +
+                              [repr(float(v)) for v in X[i]]) + "\n")
+    if qid is not None:
+        # LightGBM .query side-file: rows-per-query counts
+        _, counts = np.unique(qid, return_counts=True)
+        with open(path + ".query", "w") as f:
+            for c in counts:
+                f.write("%d\n" % c)
+
+
+def make(task, seed, n=1200, nf=12):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nf))
+    logit = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3] \
+        + 0.3 * rng.normal(size=n)
+    if task == "binary":
+        y = (logit > 0).astype(float)
+        qid = None
+    elif task == "regression":
+        y = logit
+        qid = None
+    elif task == "multiclass":
+        y = np.digitize(logit, [-1.0, 1.0]).astype(float)
+        qid = None
+    elif task == "lambdarank":
+        y = np.clip(np.digitize(logit, [-1.5, 0, 1.5]), 0, 3).astype(float)
+        assert n % 20 == 0, "lambdarank golden data needs n divisible by 20"
+        qid = np.repeat(np.arange(n // 20), 20)
+    return X, y, qid
+
+
+CONFIGS = {
+    "binary": ("objective=binary metric=binary_logloss", 11),
+    "regression": ("objective=regression metric=l2", 22),
+    "multiclass": ("objective=multiclass num_class=3 metric=multi_logloss", 33),
+    "lambdarank": ("objective=lambdarank metric=ndcg", 44),
+}
+
+
+def main(cli):
+    for task, (extra, seed) in CONFIGS.items():
+        Xtr, ytr, qtr = make(task, seed=seed)
+        Xte, yte, qte = make(task, seed=seed + 1, n=400)
+        tr = "%s/%s.train" % (HERE, task)
+        te = "%s/%s.test" % (HERE, task)
+        write_tsv(tr, ytr, Xtr, qtr)
+        write_tsv(te, yte, Xte, qte)
+        model = "%s/%s.model" % (HERE, task)
+        pred = "%s/%s.pred" % (HERE, task)
+        subprocess.run(
+            [cli, "task=train", "data=" + tr, "output_model=" + model,
+             "num_trees=15", "num_leaves=15", "learning_rate=0.1",
+             "min_data_in_leaf=20", "max_bin=63", "verbosity=-1"]
+            + extra.split(), check=True)
+        subprocess.run(
+            [cli, "task=predict", "data=" + te, "input_model=" + model,
+             "output_result=" + pred, "verbosity=-1"], check=True)
+        print("golden:", task)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
