@@ -32,7 +32,7 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
                            SPLIT_VEC_SIZE, THRESHOLD, FeatureMeta, SplitParams,
-                           find_best_split_impl)
+                           find_best_split_impl, per_feature_candidates)
 
 
 class TreeArrays(NamedTuple):
@@ -57,13 +57,30 @@ class TreeArrays(NamedTuple):
 def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  params: SplitParams, max_depth: int,
                  hist_mode: str = "scatter", hist_dtype=jnp.float32,
-                 psum_axis: str = None):
+                 psum_axis: str = None, feature_axis: str = None,
+                 voting_k: int = 0, num_voting_machines: int = 1):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
     mesh axis (data-parallel training under shard_map).
+
+    feature_axis: when set, X arrives feature-sharded ((N, F_local) per
+    shard, rows replicated) and only the packed best-split vector crosses
+    devices — an all_gather + strict-> fold reproducing the reference's
+    SplitInfo MaxReduce with its smaller-feature tie-break
+    (feature_parallel_tree_learner.cpp:52-76, split_info.hpp:102-107).
+    `meta`/`feature_mask` stay full-width; each shard slices its block.
+
+    voting_k > 0 (with psum_axis): voting-parallel — per leaf, each shard
+    proposes its local top-k features by leaf-size-weighted gain, the global
+    top-k of the pmax'd weighted gains are selected, and ONLY those k
+    histograms are psum'd (voting_parallel_tree_learner.cpp:164-300).
+    Cross-device traffic per leaf drops from F*B*3 to k*B*3.
+    num_voting_machines divides the local min_data/min_hessian constraints
+    as the reference does (voting_parallel_tree_learner.cpp:54-56).
     """
     L = num_leaves
+    voting = voting_k > 0 and psum_axis is not None
 
     if hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot, num_bins=num_bins)
@@ -76,14 +93,77 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         return x
 
     def hist_of_leaf(X, g, h, leaf_id, leaf, row_mult):
-        return maybe_psum(hist_fn(X, g, h, leaf_id, leaf, row_mult))
+        h_local = hist_fn(X, g, h, leaf_id, leaf, row_mult)
+        if voting:
+            return h_local          # voting: keep local, psum only top-k
+        return maybe_psum(h_local)
 
-    def best_of(hist, sums, feature_mask, depth):
-        b = find_best_split_impl(hist, sums[0], sums[1], sums[2], meta,
-                                 feature_mask, params)
+    if voting:
+        local_params = params._replace(
+            min_data_in_leaf=params.min_data_in_leaf / num_voting_machines,
+            min_sum_hessian_in_leaf=(params.min_sum_hessian_in_leaf
+                                     / num_voting_machines))
+
+    def depth_gate(b, depth):
         if max_depth > 0:
             b = b.at[GAIN].set(jnp.where(depth < max_depth, b[GAIN], -jnp.inf))
         return b
+
+    def best_of_serial(hist, sums, feature_mask, depth):
+        b = find_best_split_impl(hist, sums[0], sums[1], sums[2], meta,
+                                 feature_mask, params)
+        return depth_gate(b, depth)
+
+    def best_of_feature_parallel(hist, sums, feature_mask, depth,
+                                 local_meta, offset):
+        F_local = hist.shape[0]
+        local_mask = lax.dynamic_slice_in_dim(feature_mask, offset, F_local)
+        b = find_best_split_impl(hist, sums[0], sums[1], sums[2], local_meta,
+                                 local_mask, params)
+        b = b.at[FEATURE].add(offset.astype(b.dtype))
+        gathered = lax.all_gather(b, feature_axis)      # (n_shards, V)
+        # strict-> fold keeps the earlier shard on ties; shards hold
+        # contiguous feature blocks, so this IS the smaller-global-feature
+        # tie-break of SplitInfo::MaxReducer (split_info.hpp:60-76,102-107)
+        best = gathered[0]
+        for i in range(1, gathered.shape[0]):
+            take = gathered[i][GAIN] > best[GAIN]
+            best = jnp.where(take, gathered[i], best)
+        return depth_gate(best, depth)
+
+    def best_of_voting(hist_local, sums, feature_mask, depth):
+        F = hist_local.shape[0]
+        k = min(voting_k, F)
+        # local candidates against LOCAL leaf sums with constraints divided
+        # by num_machines (voting_parallel_tree_learner.cpp:54-56)
+        local_sums = jnp.sum(hist_local[0], axis=0)     # (3,) of this shard
+        cand, _, _, _, local_shift = per_feature_candidates(
+            hist_local, local_sums[0], local_sums[1], local_sums[2], meta,
+            local_params)
+        # vote on the improvement (gain minus this shard's gain_shift), the
+        # quantity the reference's SplitInfo.gain carries into GlobalVoting —
+        # raw gains would bias the vote toward shards with skewed parent sums
+        gains = jnp.where(feature_mask, cand.gain - local_shift, -jnp.inf)
+        # weight by local leaf size vs global mean (GlobalVoting,
+        # voting_parallel_tree_learner.cpp:164-193)
+        mean_cnt = jnp.maximum(sums[2] / num_voting_machines, 1.0)
+        weighted = gains * (local_sums[2] / mean_cnt)
+        weighted = jnp.where(jnp.isfinite(gains), weighted, -jnp.inf)
+        # keep only this shard's top-k proposals
+        kth = lax.top_k(weighted, k)[0][-1]
+        proposal = jnp.where(weighted >= kth, weighted, -jnp.inf)
+        global_gain = lax.pmax(proposal, psum_axis)     # (F,)
+        sel = lax.top_k(global_gain, k)[1]              # global top-k features
+        # ONLY the selected histograms cross the wire
+        hist_sel = lax.psum(jnp.take(hist_local, sel, axis=0), psum_axis)
+        sub_meta = FeatureMeta(num_bin=meta.num_bin[sel],
+                               default_bin=meta.default_bin[sel],
+                               is_categorical=meta.is_categorical[sel])
+        b = find_best_split_impl(hist_sel, sums[0], sums[1], sums[2],
+                                 sub_meta, feature_mask[sel], params)
+        f_local = b[FEATURE].astype(jnp.int32)
+        b = b.at[FEATURE].set(sel[f_local].astype(b.dtype))
+        return depth_gate(b, depth)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         n = X.shape[0]
@@ -94,7 +174,28 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         if psum_axis is not None:
             # under shard_map the row->leaf map is shard-varying from the
             # first split on; mark the initial carry accordingly (VMA rules)
-            leaf_id = lax.pvary(leaf_id, (psum_axis,))
+            try:
+                leaf_id = lax.pcast(leaf_id, (psum_axis,), to="varying")
+            except (AttributeError, TypeError):
+                leaf_id = lax.pvary(leaf_id, (psum_axis,))
+
+        if feature_axis is not None:
+            F_local = X.shape[1]
+            offset = lax.axis_index(feature_axis) * F_local
+            local_meta = FeatureMeta(
+                num_bin=lax.dynamic_slice_in_dim(
+                    meta.num_bin, offset, F_local),
+                default_bin=lax.dynamic_slice_in_dim(
+                    meta.default_bin, offset, F_local),
+                is_categorical=lax.dynamic_slice_in_dim(
+                    meta.is_categorical, offset, F_local))
+
+            def best_of(h, s, m, d):
+                return best_of_feature_parallel(h, s, m, d, local_meta, offset)
+        elif voting:
+            best_of = best_of_voting
+        else:
+            best_of = best_of_serial
 
         root_sums = maybe_psum(jnp.stack([
             jnp.sum(grad * row_mult), jnp.sum(hess * row_mult),
@@ -148,10 +249,22 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
             default_left = jnp.where(cat, dbz == thr, dbz <= thr)
 
             # ---- partition (dense_bin.hpp:190-222 semantics)
-            col = jnp.take(X, f, axis=1).astype(jnp.int32)
+            if feature_axis is not None:
+                # the winning column lives on exactly one feature shard;
+                # compute its go-left mask there and psum it to everyone —
+                # the "every rank re-executes the split" step of the
+                # reference collapses to one bitmask broadcast
+                own = (f >= offset) & (f < offset + F_local)
+                fl = jnp.clip(f - offset, 0, F_local - 1)
+                col = jnp.take(X, fl, axis=1).astype(jnp.int32)
+            else:
+                col = jnp.take(X, f, axis=1).astype(jnp.int32)
             in_leaf = leaf_id == best_leaf
             go_left = jnp.where(cat, col == thr, col <= thr)
             go_left = jnp.where(col == fdefault, default_left, go_left)
+            if feature_axis is not None:
+                go_left = lax.psum((go_left & own).astype(jnp.int32),
+                                   feature_axis) > 0
             new_leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, leaf_id)
             leaf_id = jnp.where(ok, new_leaf_id, leaf_id)
 

@@ -209,18 +209,15 @@ def _merge(best: _Cand, cand: _Cand) -> _Cand:
     return _Cand(*[jnp.where(take, cn, bn) for cn, bn in zip(cand, best)])
 
 
-def find_best_split_impl(hist, total_g, total_h, total_cnt,
-                         meta: FeatureMeta, feature_mask, params: SplitParams):
-    """Best split for one leaf.
+def per_feature_candidates(hist, total_g, total_h, total_cnt,
+                           meta: FeatureMeta, params: SplitParams):
+    """Per-feature best split candidates for one leaf.
 
-    Args:
-      hist: (F, B, 3) float histogram [sum_grad, sum_hess, count].
-      total_g / total_h / total_cnt: leaf totals (scalars).
-      meta: FeatureMeta arrays.
-      feature_mask: (F,) bool — feature_fraction sampling for this tree.
-      params: SplitParams (static).
-
-    Returns: packed (SPLIT_VEC_SIZE,) vector; gain=-inf when unsplittable.
+    Returns (best: _Cand with (F,) arrays, total_g, total_h_eps, total_cnt,
+    min_gain_shift).  `best.gain` is the raw gain (shift NOT yet subtracted);
+    -inf marks unsplittable features.  The voting-parallel learner uses this
+    to propose local top-k features (FindBestThresholds local pass,
+    voting_parallel_tree_learner.cpp:255-300).
     """
     g = hist[..., 0]
     h = hist[..., 1]
@@ -249,6 +246,26 @@ def find_best_split_impl(hist, total_g, total_h, total_cnt,
                             total_cnt, min_gain_shift)
     best = _Cand(*[jnp.where(meta.is_categorical, cn, bn)
                    for cn, bn in zip(cat, best)])
+    return best, total_g, total_h_eps, total_cnt, min_gain_shift
+
+
+def find_best_split_impl(hist, total_g, total_h, total_cnt,
+                         meta: FeatureMeta, feature_mask, params: SplitParams):
+    """Best split for one leaf.
+
+    Args:
+      hist: (F, B, 3) float histogram [sum_grad, sum_hess, count].
+      total_g / total_h / total_cnt: leaf totals (scalars).
+      meta: FeatureMeta arrays.
+      feature_mask: (F,) bool — feature_fraction sampling for this tree.
+      params: SplitParams (static).
+
+    Returns: packed (SPLIT_VEC_SIZE,) vector; gain=-inf when unsplittable.
+    """
+    best, total_g, total_h_eps, total_cnt, min_gain_shift = \
+        per_feature_candidates(hist, total_g, total_h, total_cnt, meta, params)
+    dtype = best.gain.dtype
+    eps = jnp.asarray(kEpsilon, dtype)
 
     masked_gain = jnp.where(feature_mask, best.gain, -jnp.inf)
     f = jnp.argmax(masked_gain)          # ties -> smaller feature index

@@ -11,11 +11,13 @@ into XLA collectives over an ICI mesh:
   data_parallel_tree_learner.cpp:148-222);
 * feature-parallel — all rows everywhere, features sharded; only the best
   SplitInfo crosses devices (an argmax-reduce of the packed split vector,
-  feature_parallel_tree_learner.cpp:52-76);
-* voting-parallel — data-parallel with top-k histogram exchange
-  (voting_parallel_tree_learner.cpp); on ICI bandwidth the full psum is
-  usually faster, so voting maps to the data-parallel path (kept as a
-  config alias; a true top-k exchange is a DCN-scale optimization).
+  feature_parallel_tree_learner.cpp:52-76) plus one row-bitmask psum for
+  the partition;
+* voting-parallel — data-parallel with top-k histogram exchange: local
+  top-k proposals by leaf-size-weighted gain, pmax-vote, psum of only the
+  k selected histograms (voting_parallel_tree_learner.cpp:164-300) —
+  per-leaf traffic drops from F*B*3 to top_k*B*3, the PV-Tree compression
+  for DCN-spanning meshes.
 
 Multi-host: `jax.distributed.initialize` + the same mesh spanning all
 processes replaces machine_list_file/port handshakes (linkers_socket.cpp).
@@ -41,11 +43,39 @@ from ..utils.config import Config
 from ..utils.log import Log
 
 DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
 
 
 def make_data_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def make_feature_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (FEATURE_AXIS,))
+
+
+def _shard_map_compat(fn, mesh, in_specs, out_specs, checked=True):
+    """shard_map across jax versions (check_rep renamed check_vma, removed).
+
+    checked=False disables the varying-manual-axes checker: the
+    feature-parallel grower's all_gather'd SplitInfo fold is replicated by
+    construction but the VMA analysis cannot prove it.
+    """
+    if not checked:
+        for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+            try:
+                return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+            except TypeError:
+                continue
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
 
 
 def pad_rows(n: int, num_shards: int) -> int:
@@ -86,27 +116,20 @@ class DataParallelTreeLearner(SerialTreeLearner):
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
                             hist_mode="scatter", hist_dtype=self.dtype,
-                            psum_axis=DATA_AXIS)
-        try:
-            sharded_grow = shard_map(
-                grow, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS), P()),
-                out_specs=(jax.tree_util.tree_map(lambda _: P(),
-                                                  self._dummy_tree_spec()),
-                           P(DATA_AXIS)))
-        except TypeError:
-            sharded_grow = shard_map(
-                grow, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS), P()),
-                out_specs=(jax.tree_util.tree_map(lambda _: P(),
-                                                  self._dummy_tree_spec()),
-                           P(DATA_AXIS)),
-                check_rep=False)
+                            psum_axis=DATA_AXIS, **self._grow_kwargs(n_shards))
+        sharded_grow = _shard_map_compat(
+            grow, mesh=self.mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P()),
+            out_specs=(jax.tree_util.tree_map(lambda _: P(),
+                                              self._dummy_tree_spec()),
+                       P(DATA_AXIS)))
         self._grow = jax.jit(sharded_grow)
-        Log.info("Data-parallel learner over %d devices (%d padded rows)",
-                 n_shards, pad)
+        Log.info("%s over %d devices (%d padded rows)",
+                 type(self).__name__, n_shards, pad)
+
+    def _grow_kwargs(self, n_shards):
+        return {}
 
     def _dummy_tree_spec(self):
         # a TreeArrays-shaped pytree of None leaves for out_specs mapping
@@ -133,18 +156,96 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id
 
 
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    """Data-parallel with PV-Tree top-k histogram exchange.
+
+    Identical row sharding; the grow program votes per leaf (local top_k
+    proposals weighted by leaf size, pmax, global top_k) and psums only the
+    selected feature histograms (voting_parallel_tree_learner.cpp:164-300).
+    Exact when top_k >= num_features; an approximation that preserves tree
+    quality in the PV-Tree regime otherwise.
+    """
+
+    def _grow_kwargs(self, n_shards):
+        return {"voting_k": int(self.config.top_k),
+                "num_voting_machines": int(n_shards)}
+
+
+class FeatureParallelTreeLearner(SerialTreeLearner):
+    """Feature-sharded learner: rows replicated, split search partitioned.
+
+    Each device scans its contiguous feature block; one packed SplitInfo
+    all_gather + strict-> fold picks the global best (the reference's
+    Allreduce(MaxReducer), feature_parallel_tree_learner.cpp:52-76), and a
+    single row-bitmask psum re-executes the split everywhere.  Histogram
+    memory per device shrinks by n_shards — this is the wide-dataset
+    (tensor-parallel-over-features) axis of the mesh.
+    """
+
+    def __init__(self, config: Config, train_data: TrainingData,
+                 mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_feature_mesh()
+        if FEATURE_AXIS not in self.mesh.axis_names:
+            self.mesh = make_feature_mesh(self.mesh.devices.reshape(-1))
+        n_shards = self.mesh.devices.size
+        f = max(train_data.num_features, 1)
+        fpad = (-f) % n_shards
+        self._fpad = fpad
+        binned = train_data.binned
+        if binned.size == 0:
+            binned = np.zeros((train_data.num_data, f), np.uint8)
+        if fpad:
+            binned = np.concatenate(
+                [binned, np.zeros((binned.shape[0], fpad), binned.dtype)],
+                axis=1)
+        x_sharding = NamedSharding(self.mesh, P(None, FEATURE_AXIS))
+        X_dev = jax.device_put(binned, x_sharding)
+        super().__init__(config, train_data, device_data=X_dev)
+        # padded features: num_bin=1 -> no valid threshold -> gain stays -inf
+        pad_i32 = lambda a, v: jnp.concatenate(
+            [jnp.asarray(a, jnp.int32), jnp.full(fpad, v, jnp.int32)])
+        self.meta = FeatureMeta(
+            num_bin=pad_i32(train_data.num_bin_arr, 1),
+            default_bin=pad_i32(train_data.default_bin_arr, 0),
+            is_categorical=jnp.concatenate(
+                [jnp.asarray(train_data.is_categorical_arr, bool),
+                 jnp.zeros(fpad, bool)]))
+        grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
+                            self.params, config.max_depth,
+                            hist_mode="scatter", hist_dtype=self.dtype,
+                            feature_axis=FEATURE_AXIS)
+        from ..ops.grow import TreeArrays
+        tree_specs = jax.tree_util.tree_map(
+            lambda _: P(), TreeArrays(*([0] * len(TreeArrays._fields))))
+        sharded_grow = _shard_map_compat(
+            grow, mesh=self.mesh,
+            in_specs=(P(None, FEATURE_AXIS), P(), P(), P(), P()),
+            out_specs=(tree_specs, P()), checked=False)
+        self._grow = jax.jit(sharded_grow)
+        Log.info("Feature-parallel learner over %d devices "
+                 "(%d padded features)", n_shards, fpad)
+
+    def sample_feature_mask(self):
+        mask = super().sample_feature_mask()
+        if self._fpad:
+            mask = jnp.concatenate([mask, jnp.zeros(self._fpad, bool)])
+        return mask
+
+
 def create_tree_learner(config: Config, train_data: TrainingData,
                         mesh: Optional[Mesh] = None):
     """TreeLearner::CreateTreeLearner (tree_learner.h:19-82) — learner type
-    x device dispatch.  'serial' on one device; 'data'/'feature'/'voting'
-    over the mesh ('feature' currently routes to data-parallel: with rows
-    sharded the search is already feature-complete per shard; a dedicated
-    feature-sharded search is tracked for wide datasets)."""
+    x device dispatch: 'serial' on one device; 'data'/'feature'/'voting'
+    parallel over the mesh."""
     ltype = config.tree_learner
     n_dev = len(jax.devices()) if mesh is None else mesh.devices.size
-    if ltype in ("data", "feature", "voting", "data_parallel",
-                 "feature_parallel", "voting_parallel") and n_dev > 1:
-        return DataParallelTreeLearner(config, train_data, mesh)
+    if n_dev > 1:
+        if ltype in ("data", "data_parallel"):
+            return DataParallelTreeLearner(config, train_data, mesh)
+        if ltype in ("voting", "voting_parallel"):
+            return VotingParallelTreeLearner(config, train_data, mesh)
+        if ltype in ("feature", "feature_parallel"):
+            return FeatureParallelTreeLearner(config, train_data, mesh)
     if ltype not in ("serial", "data", "feature", "voting", "data_parallel",
                      "feature_parallel", "voting_parallel"):
         Log.fatal("Unknown tree learner type %s", ltype)

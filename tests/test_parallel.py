@@ -77,3 +77,84 @@ def test_voting_alias_and_feature_alias(data):
                          "min_data_in_leaf": 5},
                         train, num_boost_round=5, verbose_eval=False)
         assert bst.num_trees() > 0
+
+
+def _tree_signature(t):
+    nl = t.num_leaves
+    return (nl, t.split_feature[:nl - 1].tolist(),
+            t.threshold_in_bin[:nl - 1].tolist(),
+            np.round(t.leaf_value[:nl], 6).tolist())
+
+
+@pytest.fixture(scope="module")
+def wide_data():
+    rng = np.random.default_rng(3)
+    n, f = 1500, 23   # f not divisible by 8 (feature-padding path)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2]
+         + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 31, "min_data_in_leaf": 10, "verbose": -1,
+                  "top_k": 64})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(n, 0.25, dtype=np.float32)
+    return cfg, td, g, h
+
+
+def test_feature_parallel_exact_match(wide_data):
+    """Feature-sharded search must reproduce the serial tree bit-for-bit:
+    same scans run, only the argmax-reduce location differs
+    (feature_parallel_tree_learner.cpp:52-76)."""
+    from lightgbm_tpu.parallel.mesh import FeatureParallelTreeLearner
+    cfg, td, g, h = wide_data
+    tree_s, leaf_s = SerialTreeLearner(cfg, td).train(g, h)
+    fp = FeatureParallelTreeLearner(cfg, td)
+    tree_f, leaf_f = fp.train(g, h)
+    assert _tree_signature(tree_f) == _tree_signature(tree_s)
+    np.testing.assert_array_equal(np.asarray(leaf_f), np.asarray(leaf_s))
+
+
+def test_voting_parallel_exact_when_topk_covers(wide_data):
+    """top_k >= num_features selects every feature, so voting must equal
+    serial exactly (modulo psum reduction order)."""
+    from lightgbm_tpu.parallel.mesh import VotingParallelTreeLearner
+    cfg, td, g, h = wide_data
+    tree_s, _ = SerialTreeLearner(cfg, td).train(g, h)
+    vt = VotingParallelTreeLearner(cfg, td)
+    tree_v = vt.materialize(vt.train_device(g, h)[0])
+    assert _tree_signature(tree_v) == _tree_signature(tree_s)
+
+
+def test_voting_parallel_topk_approximation(wide_data):
+    """Small top_k still grows a full, useful tree (PV-Tree regime)."""
+    from lightgbm_tpu.parallel.mesh import VotingParallelTreeLearner
+    cfg, td, g, h = wide_data
+    cfg_small = Config({"num_leaves": 31, "min_data_in_leaf": 10,
+                        "verbose": -1, "top_k": 5})
+    vt = VotingParallelTreeLearner(cfg_small, td)
+    tree_v = vt.materialize(vt.train_device(g, h)[0])
+    assert tree_v.num_leaves == 31
+
+
+def test_end_to_end_voting_parallel_training(data):
+    X, y = data
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "tree_learner": "voting", "top_k": 3, "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    train, num_boost_round=20, valid_sets=[train],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["training"]["auc"][-1] > 0.97
+
+
+def test_end_to_end_feature_parallel_training(data):
+    X, y = data
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "tree_learner": "feature", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    train, num_boost_round=20, valid_sets=[train],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["training"]["auc"][-1] > 0.97
