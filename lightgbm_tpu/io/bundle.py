@@ -1,0 +1,209 @@
+"""Exclusive Feature Bundling (EFB) — host-side grouping + group binning.
+
+Parity target: FindGroups / FastFeatureBundling (src/io/dataset.cpp:64-208)
+and the FeatureGroup bin-offset scheme (feature_group.h:30-117):
+
+* greedy packing of (almost-)mutually-exclusive features into one column,
+  conflict budget = total_sample * max_conflict_rate; two insertion orders
+  tried (natural, by nonzero-count desc), fewer groups wins;
+* group bin layout: bin 0 reserved for "every feature at its default";
+  feature i occupies [offset_i, offset_i + nb_i) with nb_i = num_bin
+  (minus 1 when its default bin is 0, whose slot is never stored);
+  pushed value = orig_bin + offset_i - (1 if default_i == 0 else 0),
+  default-bin rows stay 0 (feature_group.h PushData semantics).
+
+TPU-first difference: singleton groups keep RAW per-feature bins (no
+reserved slot, offset 0) so the unbundled fast path is byte-identical to
+the non-EFB layout; the learner reconstructs every feature's default-bin
+count by subtraction (the FixHistogram trick, dataset.cpp:764-783)
+uniformly for both cases.  Groups are capped at 256 bins so the binned
+matrix stays uint8 — the GPU learner's gpu_max_bin_per_group constraint
+(dataset.cpp:74) carried over because it is an HBM-width win here too.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+MAX_GROUP_BINS = 256
+MAX_SEARCH_GROUP = 100
+
+
+class BundleLayout(NamedTuple):
+    """Per-inner-feature group layout (all numpy, host side).
+
+    local_bin(f, v) = v - off[f] + adj[f]  if off[f] <= v < off[f]+span[f]
+                      default[f]           otherwise
+    """
+    groups: List[List[int]]          # group -> inner feature indices
+    group_of: np.ndarray             # (F,) int32
+    bin_off: np.ndarray              # (F,) int32
+    bin_adj: np.ndarray              # (F,) int32 (1 iff bundled & default==0)
+    bin_span: np.ndarray             # (F,) int32
+    num_group_bins: np.ndarray       # (G,) int32
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def has_bundles(self) -> bool:
+        return any(len(g) > 1 for g in self.groups)
+
+
+def _stored_bins(num_bin: int, default_bin: int) -> int:
+    """Slots a feature occupies inside a bundle (feature_group.h:40-44)."""
+    return num_bin - (1 if default_bin == 0 else 0)
+
+
+def _find_groups(order, nonzero_masks, num_bin_arr, default_bin_arr,
+                 max_error_cnt, filter_cnt, num_data, total_sample,
+                 rng) -> List[List[int]]:
+    """One greedy pass (FindGroups, dataset.cpp:64-134)."""
+    groups: List[List[int]] = []
+    marks: List[np.ndarray] = []     # per-group used-row bitmap over sample
+    conflict_cnt: List[int] = []
+    group_bins: List[int] = []       # incl. the reserved 0 slot
+
+    for f in order:
+        nz = nonzero_masks[f]
+        cnt_f = int(nz.sum())
+        nb_f = _stored_bins(int(num_bin_arr[f]), int(default_bin_arr[f]))
+        available = [g for g in range(len(groups))
+                     if group_bins[g] + nb_f <= MAX_GROUP_BINS]
+        if len(available) > MAX_SEARCH_GROUP:
+            # bounded search like the reference's rand.Sample cap
+            pick = rng.choice(len(available) - 1, MAX_SEARCH_GROUP - 1,
+                              replace=False)
+            available = [available[-1]] + [available[i] for i in pick]
+        placed = False
+        for g in available:
+            rest = max_error_cnt - conflict_cnt[g]
+            if rest < 0:
+                continue
+            cnt = int((marks[g] & nz).sum())
+            if cnt > rest:
+                continue
+            rest_nonzero = (cnt_f - cnt) * num_data / max(total_sample, 1)
+            if rest_nonzero < filter_cnt:
+                continue
+            groups[g].append(f)
+            conflict_cnt[g] += cnt
+            marks[g] |= nz
+            group_bins[g] += nb_f
+            placed = True
+            break
+        if not placed:
+            groups.append([f])
+            conflict_cnt.append(0)
+            marks.append(nz.copy())
+            group_bins.append(1 + nb_f)
+    return groups
+
+
+def find_feature_groups(binned_sample: np.ndarray, num_bin_arr: np.ndarray,
+                        default_bin_arr: np.ndarray,
+                        max_conflict_rate: float, min_data_in_leaf: int,
+                        num_data: int) -> Optional[BundleLayout]:
+    """FastFeatureBundling (dataset.cpp:139-208) on the binning sample.
+
+    binned_sample: (S, F) per-feature bins of the sampled rows.
+    Returns None when no bundle forms (caller keeps the raw layout).
+    """
+    total_sample, F = binned_sample.shape
+    if F < 2 or total_sample == 0:
+        return None
+    nonzero_masks = [binned_sample[:, f] != default_bin_arr[f]
+                     for f in range(F)]
+    max_error_cnt = int(total_sample * max_conflict_rate)
+    filter_cnt = int(0.95 * min_data_in_leaf / max(num_data, 1) * total_sample)
+    rng = np.random.default_rng(num_data)
+
+    natural = list(range(F))
+    by_cnt = sorted(natural,
+                    key=lambda f: -int(nonzero_masks[f].sum()))
+    g1 = _find_groups(natural, nonzero_masks, num_bin_arr, default_bin_arr,
+                      max_error_cnt, filter_cnt, num_data, total_sample, rng)
+    g2 = _find_groups(by_cnt, nonzero_masks, num_bin_arr, default_bin_arr,
+                      max_error_cnt, filter_cnt, num_data, total_sample, rng)
+    groups = g2 if len(g2) < len(g1) else g1
+    for g in groups:
+        g.sort()
+    if not any(len(g) > 1 for g in groups):
+        return None
+    return build_layout(groups, num_bin_arr, default_bin_arr)
+
+
+def build_layout(groups: List[List[int]], num_bin_arr: np.ndarray,
+                 default_bin_arr: np.ndarray) -> BundleLayout:
+    F = len(num_bin_arr)
+    group_of = np.zeros(F, np.int32)
+    bin_off = np.zeros(F, np.int32)
+    bin_adj = np.zeros(F, np.int32)
+    bin_span = np.zeros(F, np.int32)
+    num_group_bins = np.zeros(len(groups), np.int32)
+    for gid, feats in enumerate(groups):
+        if len(feats) == 1:
+            f = feats[0]
+            group_of[f] = gid
+            bin_off[f] = 0
+            bin_adj[f] = 0
+            bin_span[f] = num_bin_arr[f]
+            num_group_bins[gid] = num_bin_arr[f]
+        else:
+            off = 1                   # bin 0 reserved for all-default
+            for f in feats:
+                group_of[f] = gid
+                default0 = int(default_bin_arr[f]) == 0
+                bin_off[f] = off
+                bin_adj[f] = 1 if default0 else 0
+                bin_span[f] = _stored_bins(int(num_bin_arr[f]),
+                                           int(default_bin_arr[f]))
+                off += bin_span[f]
+            num_group_bins[gid] = off
+    return BundleLayout(groups=groups, group_of=group_of, bin_off=bin_off,
+                        bin_adj=bin_adj, bin_span=bin_span,
+                        num_group_bins=num_group_bins)
+
+
+def bin_rows_grouped(per_feature_bins, layout: BundleLayout,
+                     default_bin_arr: np.ndarray) -> np.ndarray:
+    """(N, G) group-binned matrix from per-feature bins.
+
+    per_feature_bins: callable f -> (N,) int bins, or (N, F) array.
+    Within a bundle, later features overwrite on (rare, budgeted) conflict
+    rows — the reference's push-order semantics.
+    """
+    if isinstance(per_feature_bins, np.ndarray):
+        getcol = lambda f: per_feature_bins[:, f]
+    else:
+        getcol = per_feature_bins
+    G = layout.num_groups
+    n = getcol(0).shape[0] if layout.groups else 0
+    dtype = np.uint8 if int(layout.num_group_bins.max(initial=2)) <= 256 \
+        else np.uint16
+    out = np.zeros((n, G), dtype=dtype)
+    for gid, feats in enumerate(layout.groups):
+        if len(feats) == 1:
+            out[:, gid] = getcol(feats[0]).astype(dtype)
+            continue
+        col = np.zeros(n, dtype=np.int64)
+        for f in feats:
+            b = np.asarray(getcol(f), np.int64)
+            nondef = b != default_bin_arr[f]
+            col[nondef] = (b[nondef] + layout.bin_off[f]
+                           - layout.bin_adj[f])
+        out[:, gid] = col.astype(dtype)
+    return out
+
+
+def local_bins_np(group_col: np.ndarray, f: int,
+                  layout: BundleLayout, default_bin: int) -> np.ndarray:
+    """Host-side local-bin reconstruction (SubFeatureIterator semantics)."""
+    v = np.asarray(group_col, np.int64)
+    off = int(layout.bin_off[f])
+    span = int(layout.bin_span[f])
+    adj = int(layout.bin_adj[f])
+    in_range = (v >= off) & (v < off + span)
+    return np.where(in_range, v - off + adj, default_bin).astype(np.int64)

@@ -24,6 +24,8 @@ from ..utils.config import Config
 from ..utils.log import Log
 from ..utils.random import Random
 from .binning import BinMapper, CATEGORICAL, NUMERICAL
+from .bundle import (BundleLayout, bin_rows_grouped, build_layout,
+                     find_feature_groups)
 from .metadata import Metadata
 from . import parser as _parser
 
@@ -53,6 +55,8 @@ class TrainingData:
         self.default_bin_arr: Optional[np.ndarray] = None
         self.is_categorical_arr: Optional[np.ndarray] = None
         self.raw_data: Optional[np.ndarray] = None    # kept for valid alignment
+        # EFB layout (io/bundle.py); None = binned is per-feature raw bins
+        self.bundle: Optional[BundleLayout] = None
 
     # ------------------------------------------------------------- construct
     @classmethod
@@ -61,7 +65,11 @@ class TrainingData:
                     categorical_feature: Sequence[int] = (),
                     feature_names: Optional[List[str]] = None,
                     reference: Optional["TrainingData"] = None,
-                    keep_raw: bool = False) -> "TrainingData":
+                    keep_raw: bool = False, comm=None) -> "TrainingData":
+        """comm: optional parallel.comm.HostComm for multi-host loading —
+        `data` is then this rank's pre-partitioned row shard and bin
+        mappers are constructed distributed (feature-sharded + allgather,
+        dataset_loader.cpp:733-833)."""
         config = config or Config()
         data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
         if data.ndim != 2:
@@ -72,10 +80,14 @@ class TrainingData:
         self.feature_names = list(feature_names) if feature_names else [
             "Column_%d" % i for i in range(self.num_total_features)]
 
+        cats = set(int(c) for c in categorical_feature)
         if reference is not None:
             self._align_with(reference, data)
+        elif comm is not None and comm.size > 1:
+            self._construct_mappers_distributed(data, config, cats, comm)
+            self._bin_data(data)
         else:
-            self._construct_mappers(data, config, set(int(c) for c in categorical_feature))
+            self._construct_mappers(data, config, cats)
             self._bin_data(data)
         if keep_raw:
             self.raw_data = data
@@ -163,6 +175,98 @@ class TrainingData:
         self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
         self._build_feature_arrays()
 
+        # EFB on the binning sample (Dataset::Construct, dataset.cpp:229-235)
+        if (config.enable_bundle and len(self.used_feature_idx) > 1
+                and config.tree_learner not in ("feature",
+                                                "feature_parallel")):
+            binned_sample = np.stack(
+                [self.bin_mappers[r].value_to_bin(sample[:, r])
+                 for r in self.used_feature_idx], axis=1)
+            self.bundle = find_feature_groups(
+                binned_sample, self.num_bin_arr, self.default_bin_arr,
+                config.max_conflict_rate, config.min_data_in_leaf,
+                self.num_data)
+            if self.bundle is not None:
+                Log.info("EFB bundled %d features into %d groups",
+                         len(self.used_feature_idx), self.bundle.num_groups)
+
+    def _construct_mappers_distributed(self, data: np.ndarray, config: Config,
+                                       categorical: set, comm) -> None:
+        """Distributed bin finding (dataset_loader.cpp:733-833): features
+        partitioned evenly across ranks; each rank finds bins for its
+        feature block from its LOCAL row shard's sample; serialized mappers
+        are allgathered so every rank holds the identical full set.
+        """
+        F = self.num_total_features
+        n_local = data.shape[0]
+        local_counts = comm.allgather_obj(int(n_local))
+        total_n = int(sum(local_counts))
+
+        sample_cnt = min(config.bin_construct_sample_cnt, n_local)
+        rng = Random(config.data_random_seed)
+        sample_idx = rng.sample(n_local, sample_cnt)
+        if len(sample_idx) == 0:
+            sample_idx = np.arange(n_local, dtype=np.int32)
+        sample = data[sample_idx]
+        total_sample = len(sample_idx)
+        # filter_cnt against the GLOBAL row count (dataset_loader.cpp:491)
+        filter_cnt = int(config.min_data_in_leaf * total_sample
+                         / max(total_n, 1))
+
+        # even feature partition, same formula on every rank
+        # (dataset_loader.cpp:741-767)
+        bounds = np.linspace(0, F, comm.size + 1).astype(int)
+        start, end = int(bounds[comm.rank]), int(bounds[comm.rank + 1])
+        my_mappers = []
+        for f in range(start, end):
+            col = sample[:, f]
+            col = col[~np.isnan(col)]
+            nonzero = col[col != 0.0]
+            m = BinMapper()
+            bin_type = CATEGORICAL if f in categorical else NUMERICAL
+            m.find_bin(nonzero, total_sample, config.max_bin,
+                       config.min_data_in_bin, filter_cnt, bin_type)
+            my_mappers.append(m.to_dict())
+
+        gathered = comm.allgather_obj(my_mappers)
+        self.bin_mappers = [BinMapper.from_dict(d)
+                            for rank_list in gathered for d in rank_list]
+        assert len(self.bin_mappers) == F
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if m is not None and not m.is_trivial]
+        if not self.used_feature_idx:
+            Log.warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+        self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
+        self._build_feature_arrays()
+
+        # EFB under distribution: every rank MUST end with the identical
+        # group structure (histogram psums assume one layout), so rank 0
+        # decides from its sample and the groups are broadcast — the
+        # allgather doubles as the broadcast.
+        if (config.enable_bundle and len(self.used_feature_idx) > 1
+                and config.tree_learner not in ("feature",
+                                                "feature_parallel")):
+            groups = None
+            if comm.rank == 0:
+                binned_sample = np.stack(
+                    [self.bin_mappers[r].value_to_bin(sample[:, r])
+                     for r in self.used_feature_idx], axis=1)
+                layout = find_feature_groups(
+                    binned_sample, self.num_bin_arr, self.default_bin_arr,
+                    config.max_conflict_rate, config.min_data_in_leaf,
+                    total_n)
+                if layout is not None:
+                    groups = [list(map(int, g)) for g in layout.groups]
+            groups = comm.allgather_obj(groups)[0]
+            if groups is not None:
+                self.bundle = build_layout(groups, self.num_bin_arr,
+                                           self.default_bin_arr)
+                if comm.rank == 0:
+                    Log.info("EFB bundled %d features into %d groups",
+                             len(self.used_feature_idx),
+                             self.bundle.num_groups)
+
     def _align_with(self, reference: "TrainingData", data: np.ndarray) -> None:
         """Valid set shares the train set's mappers
         (dataset_loader.cpp:220-261 CreateValid path)."""
@@ -176,6 +280,7 @@ class TrainingData:
         self.default_bin_arr = reference.default_bin_arr
         self.is_categorical_arr = reference.is_categorical_arr
         self.max_bin = reference.max_bin
+        self.bundle = reference.bundle
         self._bin_data(data)
 
     def _build_feature_arrays(self) -> None:
@@ -191,6 +296,13 @@ class TrainingData:
         n = data.shape[0]
         self.num_data = n
         f_used = len(self.used_feature_idx)
+        if self.bundle is not None:
+            getcol = lambda i: self.bin_mappers[
+                self.used_feature_idx[i]].value_to_bin(
+                    data[:, self.used_feature_idx[i]])
+            self.binned = bin_rows_grouped(getcol, self.bundle,
+                                           self.default_bin_arr)
+            return
         max_num_bin = int(self.num_bin_arr.max()) if f_used else 2
         dtype = np.uint8 if max_num_bin <= 256 else np.uint16
         out = np.zeros((n, f_used), dtype=dtype)
@@ -240,6 +352,7 @@ class TrainingData:
         out.is_categorical_arr = self.is_categorical_arr
         out.max_bin = self.max_bin
         out.feature_names = self.feature_names
+        out.bundle = self.bundle
         out.binned = self.binned[indices]
         out.metadata = self.metadata.subset(indices)
         return out
@@ -258,6 +371,9 @@ class TrainingData:
             "max_bin": self.max_bin,
             "bin_mappers": [None if m is None else m.to_dict()
                             for m in self.bin_mappers],
+            "bundle_groups": (None if self.bundle is None
+                              else [list(map(int, g))
+                                    for g in self.bundle.groups]),
         }
         arrays = {"binned": self.binned}
         if self.metadata.label is not None:
@@ -294,6 +410,11 @@ class TrainingData:
             self.max_bin = meta["max_bin"]
             self.bin_mappers = [None if d is None else BinMapper.from_dict(d)
                                 for d in meta["bin_mappers"]]
+            self._build_feature_arrays()
+            groups = meta.get("bundle_groups")
+            if groups is not None:
+                self.bundle = build_layout(groups, self.num_bin_arr,
+                                           self.default_bin_arr)
             self.binned = z["binned"]
             self.metadata = Metadata(self.num_data)
             if "label" in z:
@@ -304,7 +425,6 @@ class TrainingData:
                 self.metadata.query_boundaries = z["query_boundaries"]
             if "init_score" in z:
                 self.metadata.init_score = z["init_score"]
-            self._build_feature_arrays()
         return self
 
 

@@ -215,7 +215,8 @@ class GBDT:
             self._score_dev = self._score_dev.at[tid].set(
                 dev_predict.add_tree_to_score(self._score_dev[tid],
                                               self.learner.X, ta,
-                                              jnp.asarray(scale, self.score_dtype)))
+                                              jnp.asarray(scale, self.score_dtype),
+                                              self.learner.bundle_arrays))
         elif self.train_data.raw_data is not None:
             s = self.train_score
             s[tid] += scale * tree.predict(self.train_data.raw_data)
@@ -235,7 +236,8 @@ class GBDT:
             self._valid_score_dev[vi] = self._valid_score_dev[vi].at[tid].set(
                 dev_predict.add_tree_to_score(self._valid_score_dev[vi][tid],
                                               self._valid_X_dev[vi], ta,
-                                              jnp.asarray(scale, self.score_dtype)))
+                                              jnp.asarray(scale, self.score_dtype),
+                                              self.learner.bundle_arrays))
         elif self.valid_data[vi].raw_data is not None:
             s = self.valid_score_host(vi)
             s[tid] += scale * tree.predict(self.valid_data[vi].raw_data)
@@ -353,7 +355,9 @@ class GBDT:
                         dev_predict.add_tree_to_score(
                             self._valid_score_dev[vi][tid],
                             self._valid_X_dev[vi], scaled,
-                            jnp.asarray(self.shrinkage_rate, self.score_dtype)))
+                            jnp.asarray(self.shrinkage_rate,
+                                        self.score_dtype),
+                            self.learner.bundle_arrays))
                     self._invalidate_valid(vi)
                 self.models.append(None)
                 self._models_dev.append(dev_tree)

@@ -35,6 +35,22 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            find_best_split_impl, per_feature_candidates)
 
 
+class BundleArrays(NamedTuple):
+    """Device-side EFB layout (io/bundle.py BundleLayout uploaded).
+
+    The learner's histograms are built over GROUP columns (G, Bg, 3); the
+    split scan runs on per-FEATURE views gathered via `gather_idx` with the
+    default bin reconstructed by subtraction — the FixHistogram trick
+    (dataset.cpp:764-783) vectorized over all features at once.
+    """
+    group_of: jnp.ndarray        # (F,) i32 feature -> group column
+    bin_off: jnp.ndarray         # (F,) i32
+    bin_adj: jnp.ndarray         # (F,) i32
+    bin_span: jnp.ndarray        # (F,) i32
+    gather_idx: jnp.ndarray      # (F, B) i32 into flattened (G*Bg)
+    valid_mask: jnp.ndarray      # (F, B) bool — non-default, in-range bins
+
+
 class TreeArrays(NamedTuple):
     """Flat SoA tree mirroring tree.h:195-229, device-resident."""
     num_leaves: jnp.ndarray          # scalar i32
@@ -58,7 +74,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  params: SplitParams, max_depth: int,
                  hist_mode: str = "scatter", hist_dtype=jnp.float32,
                  psum_axis: str = None, feature_axis: str = None,
-                 voting_k: int = 0, num_voting_machines: int = 1):
+                 voting_k: int = 0, num_voting_machines: int = 1,
+                 bundle: BundleArrays = None, group_bins: int = 0):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -81,18 +98,35 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
     """
     L = num_leaves
     voting = voting_k > 0 and psum_axis is not None
+    if bundle is not None and feature_axis is not None:
+        raise ValueError("EFB bundling is not supported with the "
+                         "feature-parallel learner (set enable_bundle=false)")
+    hist_bins = group_bins if bundle is not None else num_bins
 
     if hist_mode == "onehot":
-        hist_fn = functools.partial(leaf_histogram_onehot, num_bins=num_bins)
+        hist_fn = functools.partial(leaf_histogram_onehot, num_bins=hist_bins)
     elif hist_mode == "pallas":
         from .pallas_hist import leaf_histogram_pallas
-        hist_fn = functools.partial(leaf_histogram_pallas, num_bins=num_bins)
+        hist_fn = functools.partial(leaf_histogram_pallas, num_bins=hist_bins)
     elif hist_mode == "scatter":
-        hist_fn = functools.partial(leaf_histogram_scatter, num_bins=num_bins)
+        hist_fn = functools.partial(leaf_histogram_scatter,
+                                    num_bins=hist_bins)
     else:
         from ..utils.log import Log
         Log.fatal("Unknown tpu_histogram_mode %s "
                   "(expected auto/scatter/onehot/pallas)", hist_mode)
+
+    def to_feature_hist(ghist, sums):
+        """Group histograms -> per-feature (F, B, 3) views with the default
+        bin rebuilt by subtraction (FixHistogram, dataset.cpp:764-783)."""
+        if bundle is None:
+            return ghist
+        flat = ghist.reshape(-1, 3)
+        v = flat[bundle.gather_idx] * bundle.valid_mask[..., None].astype(
+            ghist.dtype)
+        fidx = jnp.arange(v.shape[0])
+        v = v.at[fidx, meta.default_bin].set(sums[None, :] - v.sum(axis=1))
+        return v
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -117,7 +151,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         return b
 
     def best_of_serial(hist, sums, feature_mask, depth):
-        b = find_best_split_impl(hist, sums[0], sums[1], sums[2], meta,
+        b = find_best_split_impl(to_feature_hist(hist, sums),
+                                 sums[0], sums[1], sums[2], meta,
                                  feature_mask, params)
         return depth_gate(b, depth)
 
@@ -138,12 +173,13 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
             best = jnp.where(take, gathered[i], best)
         return depth_gate(best, depth)
 
-    def best_of_voting(hist_local, sums, feature_mask, depth):
-        F = hist_local.shape[0]
-        k = min(voting_k, F)
+    def best_of_voting(ghist_local, sums, feature_mask, depth):
         # local candidates against LOCAL leaf sums with constraints divided
         # by num_machines (voting_parallel_tree_learner.cpp:54-56)
-        local_sums = jnp.sum(hist_local[0], axis=0)     # (3,) of this shard
+        local_sums = jnp.sum(ghist_local[0], axis=0)    # (3,) of this shard
+        hist_local = to_feature_hist(ghist_local, local_sums)
+        F = hist_local.shape[0]
+        k = min(voting_k, F)
         cand, _, _, _, local_shift = per_feature_candidates(
             hist_local, local_sums[0], local_sums[1], local_sums[2], meta,
             local_params)
@@ -264,6 +300,15 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                 own = (f >= offset) & (f < offset + F_local)
                 fl = jnp.clip(f - offset, 0, F_local - 1)
                 col = jnp.take(X, fl, axis=1).astype(jnp.int32)
+            elif bundle is not None:
+                # group column -> feature-local bins (feature_group.h
+                # PushData inverted); out-of-range rows sit at the default
+                gcol = jnp.take(X, bundle.group_of[f], axis=1).astype(
+                    jnp.int32)
+                off = bundle.bin_off[f]
+                in_range = (gcol >= off) & (gcol < off + bundle.bin_span[f])
+                col = jnp.where(in_range, gcol - off + bundle.bin_adj[f],
+                                fdefault)
             else:
                 col = jnp.take(X, f, axis=1).astype(jnp.int32)
             in_leaf = leaf_id == best_leaf

@@ -22,8 +22,34 @@ from ..io.dataset import TrainingData
 from ..models.tree import Tree
 from ..utils.config import Config
 from ..utils.random import Random
-from .grow import TreeArrays, make_grow_fn
+from .grow import BundleArrays, TreeArrays, make_grow_fn
 from .split_finder import FeatureMeta, SplitParams
+
+
+def build_bundle_arrays(train_data: TrainingData):
+    """(BundleArrays, group_bins) for the device grower, or (None, 0) when
+    the dataset has no EFB layout."""
+    bund = train_data.bundle
+    if bund is None:
+        return None, 0
+    num_bin = np.asarray(train_data.num_bin_arr, np.int64)
+    default = np.asarray(train_data.default_bin_arr, np.int64)
+    B = int(num_bin.max())
+    Bg = int(bund.num_group_bins.max())
+    b = np.arange(B)[None, :]
+    gb = bund.bin_off[:, None] + b - bund.bin_adj[:, None]
+    valid = (b < num_bin[:, None]) & (b != default[:, None])
+    flat_idx = bund.group_of[:, None].astype(np.int64) * Bg + gb
+    flat_idx = np.clip(flat_idx, 0, len(bund.num_group_bins) * Bg - 1)
+    arrays = BundleArrays(
+        group_of=jnp.asarray(bund.group_of, jnp.int32),
+        bin_off=jnp.asarray(bund.bin_off, jnp.int32),
+        bin_adj=jnp.asarray(bund.bin_adj, jnp.int32),
+        bin_span=jnp.asarray(bund.bin_span, jnp.int32),
+        gather_idx=jnp.asarray(flat_idx, jnp.int32),
+        valid_mask=jnp.asarray(valid),
+    )
+    return arrays, Bg
 
 
 def build_split_params(config: Config) -> SplitParams:
@@ -60,10 +86,13 @@ class SerialTreeLearner:
             # scatter-add serializes.  On CPU the opposite holds.
             hist_mode = ("onehot" if jax.default_backend() == "tpu"
                          else "scatter")
+        self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
                             hist_mode=hist_mode, hist_dtype=self.dtype,
-                            psum_axis=psum_axis)
+                            psum_axis=psum_axis,
+                            bundle=self.bundle_arrays,
+                            group_bins=self.group_bins)
         self._grow = jax.jit(grow) if psum_axis is None else grow
         self._ones = jnp.ones(train_data.num_data, self.dtype)
         self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
@@ -128,12 +157,21 @@ class SerialTreeLearner:
         n = binned.shape[0]
         if tree.num_leaves <= 1:
             return np.zeros(n, dtype=np.int32)
+        bund = self.train_data.bundle
         node = np.zeros(n, dtype=np.int32)
         active = node >= 0
         while active.any():
             idx = np.nonzero(active)[0]
             nd = node[idx]
-            b = binned[idx, tree.split_feature_inner[nd]].astype(np.int64)
+            f = tree.split_feature_inner[nd]
+            if bund is None:
+                b = binned[idx, f].astype(np.int64)
+            else:
+                v = binned[idx, bund.group_of[f]].astype(np.int64)
+                off = bund.bin_off[f]
+                in_range = (v >= off) & (v < off + bund.bin_span[f])
+                b = np.where(in_range, v - off + bund.bin_adj[f],
+                             self.train_data.default_bin_arr[f])
             th = tree.threshold_in_bin[nd]
             is_cat = tree.decision_type[nd] == 1
             go_left = np.where(is_cat, b == th, b <= th)

@@ -64,9 +64,13 @@ def traversal_from_host_tree(tree, dtype=jnp.float32) -> TraversalArrays:
 
 
 @jax.jit
-def leaf_index_binned(tree: TraversalArrays, X):
+def leaf_index_binned(tree: TraversalArrays, X, layout=None):
     """Per-row leaf index by iterative descent (Tree::GetLeaf semantics on
-    bins); returns zeros for single-leaf trees."""
+    bins); returns zeros for single-leaf trees.
+
+    layout: optional ops.grow.BundleArrays when X holds EFB group columns —
+    bins are reconstructed per node feature (feature_group.h semantics).
+    """
     n = X.shape[0]
     rows = jnp.arange(n)
 
@@ -76,7 +80,14 @@ def leaf_index_binned(tree: TraversalArrays, X):
     def body(node):
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
-        b = X[rows, f].astype(jnp.int32)
+        if layout is None:
+            b = X[rows, f].astype(jnp.int32)
+        else:
+            v = X[rows, layout.group_of[f]].astype(jnp.int32)
+            off = layout.bin_off[f]
+            in_range = (v >= off) & (v < off + layout.bin_span[f])
+            b = jnp.where(in_range, v - off + layout.bin_adj[f],
+                          tree.default_bin[nd])
         thr = tree.threshold_bin[nd]
         cat = tree.is_cat[nd] > 0
         dbz = tree.default_bin_for_zero[nd]
@@ -94,10 +105,10 @@ def leaf_index_binned(tree: TraversalArrays, X):
 
 
 @jax.jit
-def add_tree_to_score(score, X, tree: TraversalArrays, scale):
+def add_tree_to_score(score, X, tree: TraversalArrays, scale, layout=None):
     """score += scale * clip(leaf_value)[leaf(X)] — Tree::AddPredictionToScore
     with the Shrinkage clamp (tree.h:110-118) applied at read time."""
-    leaf = leaf_index_binned(tree, X)
+    leaf = leaf_index_binned(tree, X, layout)
     vals = jnp.clip(tree.leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
     add = jnp.where(tree.num_leaves > 1, vals[leaf], 0.0)
     return score + add.astype(score.dtype)

@@ -1,0 +1,143 @@
+"""Host-level process collectives for distributed data loading.
+
+The reference's distributed bin finding (dataset_loader.cpp:733-833) rides
+the socket/MPI Network stack: features are partitioned across ranks, each
+rank constructs BinMappers for its slice from its LOCAL sample, and the
+serialized mappers are Allgathered so every rank ends with the identical
+full set.  The device-side collectives (ops/grow.py psum etc.) ride XLA
+over ICI; *loading* happens on hosts before any device program runs, so it
+needs a host-level allgather instead — `jax.distributed` process groups on
+a real pod, or an in-process simulator for tests (the moral equivalent of
+the reference running MPI single-process in CI, .travis.yml:45-52).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, List
+
+import numpy as np
+
+
+class HostComm:
+    """Host-process collective interface (Network: linkers.h:33-152)."""
+
+    @property
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        """Gather one JSON-serializable object from every rank, in rank
+        order (Network::Allgather, network.h:120-142)."""
+        raise NotImplementedError
+
+
+class SingleProcessComm(HostComm):
+    """num_machines=1 degenerate case — collectives are identities, exactly
+    like Network's small-world fast path (network.cpp:43-46)."""
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def size(self) -> int:
+        return 1
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        return [obj]
+
+
+def run_ranks(size: int, fn):
+    """Drive `fn(comm)` for `size` simulated ranks on threads with a
+    barrier at every collective — the test fixture the reference never had
+    (SURVEY.md §4: it smoke-tested MPI single-process instead).  Returns
+    the per-rank results in rank order; re-raises the first rank failure.
+    """
+    import threading
+
+    deposits = {}
+    results: List[Any] = [None] * size
+    errors: List[Any] = [None] * size
+    barrier = threading.Barrier(size)
+
+    class _ThreadComm(HostComm):
+        def __init__(self, rank):
+            self._rank = rank
+            self._round = 0
+
+        @property
+        def rank(self):
+            return self._rank
+
+        @property
+        def size(self):
+            return size
+
+        def allgather_obj(self, obj):
+            i = self._round
+            self._round += 1
+            deposits.setdefault(i, [None] * size)[self._rank] = obj
+            barrier.wait()
+            out = list(deposits[i])
+            barrier.wait()               # keep rounds from overlapping
+            return out
+
+    def runner(r):
+        try:
+            results[r] = fn(_ThreadComm(r))
+        except Exception as e:           # surface after join
+            errors[r] = e
+            barrier.abort()
+
+    threads = [threading.Thread(target=runner, args=(r,)) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    import threading as _t
+    real = [e for e in errors
+            if e is not None and not isinstance(e, _t.BrokenBarrierError)]
+    if real:
+        raise real[0]        # the rank that failed, not its stalled peers
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+class JaxProcessComm(HostComm):
+    """Multi-host pod loading: allgather via jax.experimental
+    multihost_utils (replaces machine_list_file + TCP handshake,
+    linkers_socket.cpp).  Requires jax.distributed.initialize()."""
+
+    def __init__(self):
+        import jax
+        self._rank = jax.process_index()
+        self._size = jax.process_count()
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        import jax
+        from jax.experimental import multihost_utils
+        payload = json.dumps(obj).encode()
+        n = np.zeros(1, np.int32) + len(payload)
+        sizes = multihost_utils.process_allgather(n).reshape(-1)
+        buf = np.zeros(int(sizes.max()), np.uint8)
+        buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        out = []
+        for r in range(self._size):
+            raw = bytes(np.asarray(gathered[r][:int(sizes[r])]))
+            out.append(json.loads(raw.decode()))
+        return out

@@ -116,7 +116,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
                             hist_mode="scatter", hist_dtype=self.dtype,
-                            psum_axis=DATA_AXIS, **self._grow_kwargs(n_shards))
+                            psum_axis=DATA_AXIS,
+                            bundle=self.bundle_arrays,
+                            group_bins=self.group_bins,
+                            **self._grow_kwargs(n_shards))
         sharded_grow = _shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
@@ -184,6 +187,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config: Config, train_data: TrainingData,
                  mesh: Optional[Mesh] = None):
+        if train_data.bundle is not None:
+            Log.fatal("The feature-parallel learner requires "
+                      "enable_bundle=false (dataset was built with EFB)")
         self.mesh = mesh if mesh is not None else make_feature_mesh()
         if FEATURE_AXIS not in self.mesh.axis_names:
             self.mesh = make_feature_mesh(self.mesh.devices.reshape(-1))

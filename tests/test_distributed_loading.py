@@ -1,0 +1,138 @@
+"""Distributed bin finding (dataset_loader.cpp:733-833 analog): features
+partitioned across ranks, mappers allgathered — driven by the threaded
+multi-rank fixture in parallel/comm.py."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.parallel.comm import SingleProcessComm, run_ranks
+from lightgbm_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    n, f = 4000, 11
+    X = rng.normal(size=(n, f))
+    X[:, 2] = rng.integers(0, 6, n)          # low-cardinality column
+    X[rng.uniform(size=n) < 0.3, 4] = 0.0    # sparse-ish column
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _mapper_sig(td):
+    return [(m.num_bin, m.bin_type, list(np.round(m.bin_upper_bound, 12)))
+            for m in td.bin_mappers]
+
+
+def test_same_data_matches_serial(data):
+    """Every rank holding the full data must reproduce the serial mappers
+    exactly (same sample, same greedy packing)."""
+    X, y = data
+    cfg = Config({"verbose": -1})
+    serial = TrainingData.from_matrix(X, label=y, config=cfg)
+
+    def load(comm):
+        return TrainingData.from_matrix(X, label=y, config=Config(
+            {"verbose": -1}), comm=comm)
+
+    for td in run_ranks(4, load):
+        assert _mapper_sig(td) == _mapper_sig(serial)
+        np.testing.assert_array_equal(td.binned, serial.binned)
+
+
+def test_row_sharded_ranks_agree(data):
+    """Pre-partitioned rows: all ranks must end with the IDENTICAL mapper
+    set (each rank contributed its feature block, then allgathered)."""
+    X, y = data
+    shards = np.array_split(np.arange(len(y)), 4)
+
+    def load(comm):
+        idx = shards[comm.rank]
+        return TrainingData.from_matrix(X[idx], label=y[idx],
+                                        config=Config({"verbose": -1}),
+                                        comm=comm)
+
+    tds = run_ranks(4, load)
+    sig0 = _mapper_sig(tds[0])
+    for td in tds[1:]:
+        assert _mapper_sig(td) == sig0
+    # local shard shapes
+    for r, td in enumerate(tds):
+        assert td.num_data == len(shards[r])
+        assert td.binned.shape == (len(shards[r]), td.num_features)
+
+
+def test_row_sharded_training_works(data):
+    """A shard loaded distributed trains to a sane model end-to-end."""
+    X, y = data
+    shards = np.array_split(np.arange(len(y)), 2)
+
+    def load(comm):
+        idx = shards[comm.rank]
+        return TrainingData.from_matrix(X[idx], label=y[idx],
+                                        config=Config({"verbose": -1}),
+                                        comm=comm)
+
+    td0 = run_ranks(2, load)[0]
+    ds = lgb.Dataset(X[shards[0]], label=y[shards[0]])
+    ds._handle = td0
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 15,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=10)
+    p = bst.predict(X[shards[1]])
+    acc = ((p > 0.5) == (y[shards[1]] > 0)).mean()
+    assert acc > 0.9
+
+
+def test_single_process_comm_is_identity():
+    c = SingleProcessComm()
+    assert c.rank == 0 and c.size == 1
+    assert c.allgather_obj({"a": 1}) == [{"a": 1}]
+
+
+def test_distributed_efb_consistent(data):
+    """EFB under distribution: rank 0 decides the bundles, every rank ends
+    with the identical group structure; same-data ranks match serial."""
+    rng = np.random.default_rng(9)
+    n, cats = 3000, 6
+    c = rng.integers(0, cats, n)
+    X = np.concatenate([rng.normal(size=(n, 2)), np.eye(cats)[c]], axis=1)
+    y = (c % 2 == 0).astype(np.float64)
+    serial = TrainingData.from_matrix(X, label=y, config=Config(
+        {"verbose": -1}))
+    assert serial.bundle is not None
+
+    def load_same(comm):
+        return TrainingData.from_matrix(X, label=y, config=Config(
+            {"verbose": -1}), comm=comm)
+
+    for td in run_ranks(3, load_same):
+        assert td.bundle is not None
+        assert [list(g) for g in td.bundle.groups] == \
+            [list(g) for g in serial.bundle.groups]
+        np.testing.assert_array_equal(td.binned, serial.binned)
+
+    shards = np.array_split(np.arange(n), 3)
+
+    def load_shard(comm):
+        idx = shards[comm.rank]
+        return TrainingData.from_matrix(X[idx], label=y[idx], config=Config(
+            {"verbose": -1}), comm=comm)
+
+    tds = run_ranks(3, load_shard)
+    g0 = [list(g) for g in tds[0].bundle.groups]
+    assert all([list(g) for g in td.bundle.groups] == g0 for td in tds[1:])
+
+
+def test_more_ranks_than_features(data):
+    """Ranks beyond the feature count contribute empty blocks."""
+    X, y = data
+    Xs = X[:, :3]
+
+    def load(comm):
+        return TrainingData.from_matrix(Xs, label=y, config=Config(
+            {"verbose": -1}), comm=comm)
+
+    tds = run_ranks(6, load)
+    assert all(len(td.bin_mappers) == 3 for td in tds)

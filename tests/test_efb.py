@@ -1,0 +1,148 @@
+"""Exclusive Feature Bundling (dataset.cpp:64-208, feature_group.h:30-117).
+
+The key invariant: with max_conflict_rate=0 the bundled representation is
+lossless, so training with EFB on must produce EXACTLY the trees of
+training with enable_bundle=false.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bundle import (bin_rows_grouped, build_layout,
+                                    find_feature_groups, local_bins_np)
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.utils.config import Config
+
+
+def _onehot_data(n=3000, cats=6, seed=0):
+    """A one-hot encoded categorical (mutually exclusive by construction)
+    plus two dense columns."""
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, cats, n)
+    oh = np.eye(cats)[c]            # 0/1: each column needs 2 bins
+    dense = rng.normal(size=(n, 2))
+    X = np.concatenate([dense, oh], axis=1)
+    y = ((c % 2 == 0) ^ (dense[:, 0] > 0)).astype(np.float64)
+    return X, y
+
+
+def test_bundles_form_on_onehot():
+    X, y = _onehot_data()
+    cfg = Config({"verbose": -1})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    assert td.bundle is not None
+    assert td.bundle.num_groups < td.num_features
+    assert td.binned.shape == (len(y), td.bundle.num_groups)
+    # the 6 exclusive one-hot columns share one group
+    sizes = sorted(len(g) for g in td.bundle.groups)
+    assert sizes[-1] >= 5
+
+
+def test_bundle_roundtrip_local_bins():
+    """group bins -> local bins inverts the push mapping for every feature."""
+    X, y = _onehot_data()
+    cfg = Config({"verbose": -1})
+    td_plain = TrainingData.from_matrix(X, label=y, config=Config(
+        {"verbose": -1, "enable_bundle": False}))
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    assert td.bundle is not None
+    for f in range(td.num_features):
+        g = td.bundle.group_of[f]
+        got = local_bins_np(td.binned[:, g], f, td.bundle,
+                            int(td.default_bin_arr[f]))
+        np.testing.assert_array_equal(got, td_plain.binned[:, f].astype(np.int64))
+
+
+def test_efb_training_matches_plain():
+    """Zero-conflict bundles are lossless up to f32 reduction order: the
+    first tree is structurally identical (same scans, the default bin
+    reconstructed by FixHistogram subtraction), and multi-round predictions
+    agree to float noise — the same tolerance class as the reference's
+    CPU-vs-GPU table (docs/GPU-Performance.md:134)."""
+    X, y = _onehot_data()
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 31,
+              "min_data_in_leaf": 5, "metric": "auc"}
+    strip = lambda s: s.split("parameters:")[0]
+    m1 = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=1)
+    m2 = lgb.train(dict(params, enable_bundle=False),
+                   lgb.Dataset(X, label=y), num_boost_round=1)
+    assert strip(m1.model_to_string()) == strip(m2.model_to_string())
+
+    m1 = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=15)
+    m2 = lgb.train(dict(params, enable_bundle=False),
+                   lgb.Dataset(X, label=y), num_boost_round=15)
+    np.testing.assert_allclose(m1.predict(X), m2.predict(X), atol=1e-4)
+
+
+def test_efb_with_valid_and_early_stopping():
+    X, y = _onehot_data(seed=3)
+    Xv, yv = _onehot_data(seed=4)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 15,
+                     "metric": "auc"}, train, num_boost_round=20,
+                    valid_sets=[valid], evals_result=evals,
+                    verbose_eval=False)
+    assert evals["valid_0"]["auc"][-1] > 0.95
+    p = bst.predict(Xv)
+    assert (((p > 0.5) == (yv > 0)).mean()) > 0.9
+
+
+def test_efb_dart_and_goss():
+    X, y = _onehot_data(seed=5)
+    for boosting in ("dart", "goss"):
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "boosting": boosting, "num_leaves": 15},
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+        p = bst.predict(X)
+        assert (((p > 0.5) == (y > 0)).mean()) > 0.8
+
+
+def test_efb_binary_dataset_roundtrip(tmp_path):
+    X, y = _onehot_data(seed=6)
+    td = TrainingData.from_matrix(X, label=y, config=Config({"verbose": -1}))
+    assert td.bundle is not None
+    fn = str(tmp_path / "ds.npz")
+    td.save_binary(fn)
+    td2 = TrainingData.load_binary(fn)
+    assert td2.bundle is not None
+    assert [list(g) for g in td2.bundle.groups] == \
+        [list(g) for g in td.bundle.groups]
+    np.testing.assert_array_equal(td2.binned, td.binned)
+
+
+def test_efb_data_parallel_matches_serial():
+    import jax
+    from lightgbm_tpu.ops.learner import SerialTreeLearner
+    from lightgbm_tpu.parallel.mesh import (DataParallelTreeLearner,
+                                            make_data_mesh)
+    X, y = _onehot_data(seed=7)
+    cfg = Config({"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    assert td.bundle is not None
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(len(y), 0.25, np.float32)
+    tree_s, leaf_s = SerialTreeLearner(cfg, td).train(g, h)
+    dp = DataParallelTreeLearner(cfg, td, make_data_mesh(jax.devices()))
+    tree_d = dp.materialize(dp.train_device(g, h)[0])
+    assert tree_d.num_leaves == tree_s.num_leaves
+    np.testing.assert_array_equal(
+        tree_d.split_feature[:tree_d.num_leaves - 1],
+        tree_s.split_feature[:tree_s.num_leaves - 1])
+
+
+def test_max_conflict_rate_budget():
+    """Conflicting features bundle only when the budget allows."""
+    rng = np.random.default_rng(8)
+    n = 2000
+    a = np.where(rng.uniform(size=n) < 0.5, rng.normal(size=n), 0.0)
+    b = np.where(rng.uniform(size=n) < 0.5, rng.normal(size=n), 0.0)
+    X = np.stack([a, b], axis=1)      # ~25% conflict rate
+    y = (a + b > 0).astype(np.float64)
+    td0 = TrainingData.from_matrix(X, label=y, config=Config(
+        {"verbose": -1, "max_conflict_rate": 0.0, "max_bin": 63}))
+    assert td0.bundle is None         # conflicts exceed zero budget
+    td1 = TrainingData.from_matrix(X, label=y, config=Config(
+        {"verbose": -1, "max_conflict_rate": 0.5, "max_bin": 63}))
+    assert td1.bundle is not None and td1.bundle.num_groups == 1
