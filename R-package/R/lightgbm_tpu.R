@@ -1,0 +1,139 @@
+# R interface to lightgbm_tpu — API parity with the reference R-package
+# (R-package/R/lgb.Dataset.R, lgb.Booster.R, lgb.cv.R at the reference).
+#
+# The reference R package reaches C++ through 633 lines of SEXP glue
+# (src/lightgbm_R.cpp) over the C API.  Here the compute plane is XLA on
+# TPU driven from Python, so the FFI boundary is the Python package via
+# reticulate; every function below delegates to the same lightgbm_tpu
+# calls the Python API uses, keeping one behavior for both languages.
+
+.lgb_env <- new.env(parent = emptyenv())
+
+.lgb_py <- function() {
+  if (is.null(.lgb_env$mod)) {
+    if (!requireNamespace("reticulate", quietly = TRUE)) {
+      stop("lightgbm.tpu requires the 'reticulate' package")
+    }
+    .lgb_env$mod <- reticulate::import("lightgbm_tpu")
+  }
+  .lgb_env$mod
+}
+
+.as_py_params <- function(params) {
+  if (is.null(params)) params <- list()
+  # R scalars pass through reticulate; names kept verbatim — parameter
+  # names/aliases are the cross-language API (config.h:360-489)
+  params
+}
+
+#' Create a lightgbm_tpu Dataset
+#' @param data matrix or file path
+#' @param label numeric vector of labels
+#' @param ... weight, group, init_score, categorical_feature, reference
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, categorical_feature = NULL,
+                        reference = NULL, params = list()) {
+  lgb <- .lgb_py()
+  cat_feat <- if (is.null(categorical_feature)) {
+    "auto"
+  } else if (is.numeric(categorical_feature)) {
+    as.integer(categorical_feature - 1L)     # R is 1-based
+  } else {
+    categorical_feature                      # column names pass through
+  }
+  ds <- lgb$Dataset(
+    data = data, label = label, weight = weight, group = group,
+    init_score = init_score, categorical_feature = cat_feat,
+    reference = reference, params = .as_py_params(params))
+  class(ds) <- c("lgb.Dataset", class(ds))
+  ds
+}
+
+#' Validation dataset aligned with a training Dataset
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+#' Train a boosting model (engine.py train parity)
+lgb.train <- function(params = list(), data, nrounds = 10L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      init_model = NULL, verbose_eval = TRUE, ...) {
+  lgb <- .lgb_py()
+  valid_sets <- unname(valids)
+  valid_names <- names(valids)
+  bst <- lgb$train(
+    params = .as_py_params(params), train_set = data,
+    num_boost_round = as.integer(nrounds),
+    valid_sets = valid_sets, valid_names = valid_names,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
+                            else as.integer(early_stopping_rounds),
+    init_model = init_model, verbose_eval = verbose_eval)
+  class(bst) <- c("lgb.Booster", class(bst))
+  bst
+}
+
+#' Cross validation (engine.py cv parity)
+lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
+                   stratified = TRUE, early_stopping_rounds = NULL, ...) {
+  lgb <- .lgb_py()
+  lgb$cv(params = .as_py_params(params), train_set = data,
+         num_boost_round = as.integer(nrounds), nfold = as.integer(nfold),
+         stratified = stratified,
+         early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
+                                 else as.integer(early_stopping_rounds))
+}
+
+#' Predict with a trained booster
+predict.lgb.Booster <- function(object, data, num_iteration = -1L,
+                                rawscore = FALSE, predleaf = FALSE, ...) {
+  object$predict(data, num_iteration = as.integer(num_iteration),
+                 raw_score = rawscore, pred_leaf = predleaf)
+}
+
+print.lgb.Booster <- function(x, ...) {
+  cat(sprintf("<lgb.Booster: %d trees>\n", x$num_trees()))
+  invisible(x)
+}
+
+#' Save / load / dump — the text model format is the compatibility surface
+#' (GBDT::SaveModelToString, gbdt.cpp:817-861)
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  booster$save_model(filename, num_iteration = as.integer(num_iteration))
+  invisible(booster)
+}
+
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  lgb <- .lgb_py()
+  bst <- if (!is.null(filename)) lgb$Booster(model_file = filename)
+         else lgb$Booster(model_str = model_str)
+  class(bst) <- c("lgb.Booster", class(bst))
+  bst
+}
+
+lgb.dump <- function(booster, num_iteration = -1L) {
+  booster$dump_model(num_iteration = as.integer(num_iteration))
+}
+
+lgb.model.to.string <- function(booster, num_iteration = -1L) {
+  booster$model_to_string(num_iteration = as.integer(num_iteration))
+}
+
+#' Split-count feature importance (GBDT::FeatureImportance parity)
+lgb.importance <- function(booster, percentage = TRUE) {
+  imp <- booster$feature_importance()
+  names(imp) <- booster$feature_name()
+  if (percentage && sum(imp) > 0) imp <- imp / sum(imp)
+  imp
+}
+
+lgb.get.eval.result <- function(booster, data_name, eval_name) {
+  # one (dataset, metric, value, higher_better) tuple list per call;
+  # filter to the requested pair like the reference's accessor
+  out <- c()
+  for (tup in booster$eval_valid()) {
+    if (identical(tup[[1]], data_name) && identical(tup[[2]], eval_name)) {
+      out <- c(out, tup[[3]])
+    }
+  }
+  out
+}

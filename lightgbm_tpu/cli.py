@@ -85,16 +85,29 @@ def run_train(cfg: Config) -> None:
         booster.add_valid_dataset(valid_td, metrics)
     Log.info("Started training...")
     import time
-    for it in range(cfg.num_iterations):
-        t0 = time.time()
-        stop = booster.train_one_iter(None, None, True)
-        Log.info("%f seconds elapsed, finished iteration %d",
-                 time.time() - t0, it + 1)
-        if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
-            booster.save_model_to_file("%s.snapshot_iter_%d"
-                                       % (cfg.output_model, it + 1))
-        if stop:
-            break
+    # XLA-level tracing: the TIMETAG/#ifdef timers of the reference
+    # (gbdt.cpp:21-30, serial_tree_learner.cpp:10-17) become a
+    # jax.profiler trace viewable in TensorBoard/Perfetto
+    profile_dir = cfg.raw.get("tpu_profile_dir", "")
+    if profile_dir:
+        import jax
+        jax.profiler.start_trace(str(profile_dir))
+        Log.info("jax.profiler trace -> %s", profile_dir)
+    try:
+        for it in range(cfg.num_iterations):
+            t0 = time.time()
+            stop = booster.train_one_iter(None, None, True)
+            Log.info("%f seconds elapsed, finished iteration %d",
+                     time.time() - t0, it + 1)
+            if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
+                booster.save_model_to_file("%s.snapshot_iter_%d"
+                                           % (cfg.output_model, it + 1))
+            if stop:
+                break
+    finally:
+        if profile_dir:
+            import jax
+            jax.profiler.stop_trace()   # keep the trace on failures too
     booster.save_model_to_file(cfg.output_model)
     Log.info("Finished training")
 

@@ -130,7 +130,7 @@ PARAMETER_SET = {
     "output_freq", "is_provide_training_metric", "machine_list_filename",
     "capacity",
     # tpu-native additions
-    "tpu_use_dp", "tpu_histogram_mode", "feature_name",
+    "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
