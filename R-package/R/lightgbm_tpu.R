@@ -37,9 +37,11 @@ lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
   cat_feat <- if (is.null(categorical_feature)) {
     "auto"
   } else if (is.numeric(categorical_feature)) {
-    as.integer(categorical_feature - 1L)     # R is 1-based
+    # R is 1-based; as.list keeps length-1 vectors a Python list, not a
+    # bare scalar, through reticulate
+    as.list(as.integer(categorical_feature - 1L))
   } else {
-    categorical_feature                      # column names pass through
+    as.list(categorical_feature)   # column names, resolved Python-side
   }
   ds <- lgb$Dataset(
     data = data, label = label, weight = weight, group = group,

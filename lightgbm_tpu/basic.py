@@ -84,10 +84,23 @@ class Dataset:
                                                       reference=ref_td)
         else:
             data = np.asarray(self.data, dtype=np.float64)
-            if self.categorical_feature not in (None, "auto"):
-                cat = [int(c) for c in self.categorical_feature]
             if self.feature_name not in (None, "auto"):
                 feature_names = list(self.feature_name)
+            if self.categorical_feature not in (None, "auto"):
+                spec = self.categorical_feature
+                if isinstance(spec, (int, str)):
+                    spec = [spec]      # scalar from bindings (e.g. R)
+                cat = []
+                for c in spec:
+                    if isinstance(c, str):
+                        # column-name spec (basic.py:224-291 pandas path
+                        # semantics): resolve against feature names
+                        if feature_names and c in feature_names:
+                            cat.append(feature_names.index(c))
+                        else:
+                            Log.warning("Unknown categorical column %s", c)
+                    else:
+                        cat.append(int(c))
             ref_td = None
             if self.reference is not None:
                 self.reference.construct()
@@ -351,6 +364,36 @@ class Booster:
         else:
             raw = self._gbdt.valid_score_host(data_idx - 1)
         return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
+
+    def reset_parameter(self, params: dict) -> "Booster":
+        """LGBM_BoosterResetParameter semantics: learning_rate applies to
+        the running engine immediately; other params are recorded."""
+        params = dict(params or {})
+        self.params.update(params)
+        if "learning_rate" in params:
+            self._gbdt.shrinkage_rate = float(params["learning_rate"])
+        return self
+
+    def set_train_data(self, train_set: "Dataset") -> "Booster":
+        """LGBM_BoosterResetTrainingData: swap the training dataset while
+        keeping the model (GBDT::ResetTrainingData, gbdt.cpp:64-208)."""
+        cfg = Config(dict(self.params))
+        train_set._update_params(self.params).construct()
+        objective = create_objective(cfg.objective, cfg)
+        if objective is not None:
+            objective.init(train_set._handle.metadata,
+                           train_set._handle.num_data)
+        metrics = []
+        for mname in cfg.metrics():
+            m = create_metric(mname, cfg)
+            if m is not None:
+                m.init(train_set._handle.metadata, train_set._handle.num_data)
+                metrics.append(m)
+        self._gbdt.reset_training_data(cfg, train_set._handle, objective,
+                                       metrics)
+        self._train_set = train_set
+        self._cfg = cfg          # later add_valid must see the new config
+        return self
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()

@@ -32,7 +32,38 @@ def _register(obj) -> int:
 def _get(handle: int):
     if handle not in _handles:
         raise LightGBMError("Invalid handle %s" % handle)
+    obj = _handles[handle]
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "pushing":
+        raise LightGBMError(
+            "Dataset %s is still streaming: %d of %d declared rows pushed "
+            "(LGBM_DatasetPushRows)" % (
+                handle, sum(len(r) for r in obj[1]["rows"]),
+                obj[1]["num_total_row"]))
+    return obj
+
+
+def _get_pushing(handle: int):
+    if handle not in _handles:
+        raise LightGBMError("Invalid handle %s" % handle)
     return _handles[handle]
+
+
+def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
+    n = len(indptr) - 1
+    mat = np.zeros((n, num_col), dtype=np.float64)
+    for r in range(n):
+        for j in range(indptr[r], indptr[r + 1]):
+            mat[r, indices[j]] = data[j]
+    return mat
+
+
+def _csc_to_dense(colptr, indices, data, num_row: int) -> np.ndarray:
+    num_col = len(colptr) - 1
+    mat = np.zeros((num_row, num_col), dtype=np.float64)
+    for c in range(num_col):
+        for j in range(colptr[c], colptr[c + 1]):
+            mat[indices[j], c] = data[j]
+    return mat
 
 
 def _parse_params(parameters: str) -> dict:
@@ -69,23 +100,17 @@ def LGBM_DatasetCreateFromMat(data, parameters: str = "",
 def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col: int,
                               parameters: str = "",
                               reference: Optional[int] = None) -> int:
-    n = len(indptr) - 1
-    mat = np.zeros((n, num_col), dtype=np.float64)
-    for r in range(n):
-        for j in range(indptr[r], indptr[r + 1]):
-            mat[r, indices[j]] = data[j]
-    return LGBM_DatasetCreateFromMat(mat, parameters, reference)
+    return LGBM_DatasetCreateFromMat(_csr_to_dense(indptr, indices, data,
+                                                   num_col),
+                                     parameters, reference)
 
 
 def LGBM_DatasetCreateFromCSC(colptr, indices, data, num_row: int,
                               parameters: str = "",
                               reference: Optional[int] = None) -> int:
-    num_col = len(colptr) - 1
-    mat = np.zeros((num_row, num_col), dtype=np.float64)
-    for c in range(num_col):
-        for j in range(colptr[c], colptr[c + 1]):
-            mat[indices[j], c] = data[j]
-    return LGBM_DatasetCreateFromMat(mat, parameters, reference)
+    return LGBM_DatasetCreateFromMat(_csc_to_dense(colptr, indices, data,
+                                                   num_row),
+                                     parameters, reference)
 
 
 def LGBM_DatasetSetField(handle: int, field_name: str, data) -> int:
@@ -234,3 +259,190 @@ def LGBM_BoosterFeatureImportance(handle: int, num_iteration: int = -1):
 def LGBM_BoosterFree(handle: int) -> int:
     _handles.pop(handle, None)
     return 0
+
+
+# ------------------------------------------------- error handling (c_api.h)
+
+_last_error: List[str] = [""]
+
+
+def LGBM_SetLastError(msg: str) -> None:
+    _last_error[0] = str(msg)
+
+
+def LGBM_GetLastError() -> str:
+    return _last_error[0]
+
+
+def LGBM_APIHandleException(ex) -> int:
+    """Reference macro API_END catch-all (c_api.cpp): record and return -1."""
+    LGBM_SetLastError(str(ex))
+    return -1
+
+
+# --------------------------------------------- remaining dataset functions
+
+def LGBM_DatasetCreateByReference(reference: int, num_total_row: int) -> int:
+    """Empty dataset aligned to a reference for row streaming
+    (c_api.h LGBM_DatasetCreateByReference + PushRows protocol)."""
+    ds = {"reference": _get(reference), "num_total_row": int(num_total_row),
+          "rows": []}     # list of (start_row, chunk) — any push order
+    return _register(("pushing", ds))
+
+
+def LGBM_DatasetPushRows(handle: int, data, start_row: int = -1) -> int:
+    """Chunks may arrive in any order (multi-threaded producers push with
+    explicit start_row, c_api.h:120-140); start_row=-1 appends after the
+    last pushed row."""
+    obj = _get_pushing(handle)
+    if not (isinstance(obj, tuple) and obj[0] == "pushing"):
+        raise LightGBMError("Dataset was not created for row pushing")
+    _, ds = obj
+    chunk = np.asarray(data, dtype=np.float64)
+    if start_row is None or start_row < 0:
+        start_row = sum(len(c) for _, c in ds["rows"])
+    ds["rows"].append((int(start_row), chunk))
+    if sum(len(c) for _, c in ds["rows"]) >= ds["num_total_row"]:
+        _finish_push(handle, ds)
+    return 0
+
+
+def LGBM_DatasetPushRowsByCSR(handle: int, indptr, indices, data,
+                              num_col: int, start_row: int = -1) -> int:
+    return LGBM_DatasetPushRows(handle,
+                                _csr_to_dense(indptr, indices, data, num_col),
+                                start_row)
+
+
+def _finish_push(handle: int, ds: dict) -> None:
+    n = ds["num_total_row"]
+    f = ds["rows"][0][1].shape[1]
+    mat = np.zeros((n, f), dtype=np.float64)
+    for start, chunk in ds["rows"]:
+        end = min(start + len(chunk), n)
+        mat[start:end] = chunk[:end - start]
+    out = Dataset(mat, reference=ds["reference"], free_raw_data=False)
+    out.construct()
+    _handles[handle] = out
+
+
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices,
+                                        num_col: int, num_per_col,
+                                        num_sample_row: int,
+                                        num_total_row: int,
+                                        parameters: str = "") -> int:
+    """Sampled-column creation (c_api.h:78-101): bin mappers from column
+    samples, rows streamed afterwards via LGBM_DatasetPushRows."""
+    mat = np.zeros((num_sample_row, num_col), dtype=np.float64)
+    for c in range(num_col):
+        vals = np.asarray(sample_data[c], dtype=np.float64)
+        idx = np.asarray(sample_indices[c], dtype=np.int64)
+        mat[idx[:len(vals)], c] = vals
+    params = _parse_params(parameters)
+    ref = Dataset(mat, params=params, free_raw_data=False)
+    ref.construct()
+    ds = {"reference": ref, "num_total_row": int(num_total_row), "rows": []}
+    return _register(("pushing", ds))
+
+
+def LGBM_DatasetGetSubset(handle: int, used_row_indices,
+                          parameters: str = "") -> int:
+    sub = _get(handle).subset(np.asarray(used_row_indices, dtype=np.int64),
+                              params=_parse_params(parameters))
+    sub.construct()
+    return _register(sub)
+
+
+def LGBM_DatasetSetFeatureNames(handle: int, feature_names: List[str]) -> int:
+    ds = _get(handle)
+    ds.set_feature_name(list(feature_names))
+    return 0
+
+
+def LGBM_DatasetGetFeatureNames(handle: int) -> List[str]:
+    ds = _get(handle)
+    ds.construct()
+    return list(ds._handle.feature_names)
+
+
+# --------------------------------------------- remaining booster functions
+
+def LGBM_BoosterMerge(handle: int, other_handle: int) -> int:
+    """Merge other's trees into handle (c_api.cpp Booster::MergeFrom)."""
+    a = _get(handle)._gbdt
+    b = _get(other_handle)._gbdt
+    a._materialize()
+    b._materialize()
+    a.merge_from(b)
+    return 0
+
+
+def LGBM_BoosterResetParameter(handle: int, parameters: str) -> int:
+    bst = _get(handle)
+    bst.reset_parameter(key_alias_transform(_parse_params(parameters)))
+    return 0
+
+
+def LGBM_BoosterResetTrainingData(handle: int, train_data: int) -> int:
+    bst = _get(handle)
+    bst.set_train_data(_get(train_data))
+    return 0
+
+
+def LGBM_BoosterGetNumFeature(handle: int) -> int:
+    return int(_get(handle)._gbdt.max_feature_idx + 1)
+
+
+def LGBM_BoosterGetEvalCounts(handle: int) -> int:
+    return len(LGBM_BoosterGetEvalNames(handle))
+
+
+def LGBM_BoosterCalcNumPredict(handle: int, num_row: int,
+                               predict_type: int = 0,
+                               num_iteration: int = -1) -> int:
+    gbdt = _get(handle)._gbdt
+    k = gbdt.num_tree_per_iteration
+    if predict_type == 2:    # leaf index: one per tree
+        total = len(gbdt.models) // max(k, 1)
+        n_iter = min(num_iteration, total) if num_iteration > 0 else total
+        return num_row * k * n_iter
+    return num_row * k
+
+
+def LGBM_BoosterGetNumPredict(handle: int, data_idx: int) -> int:
+    gbdt = _get(handle)._gbdt
+    if data_idx == 0:
+        n = gbdt.num_data
+    else:
+        n = gbdt.valid_data[data_idx - 1].num_data
+    return n * gbdt.num_tree_per_iteration
+
+
+def LGBM_BoosterGetPredict(handle: int, data_idx: int):
+    """Raw scores of train (0) or valid set (1..) — c_api GetPredict."""
+    gbdt = _get(handle)._gbdt
+    if data_idx == 0:
+        return np.asarray(gbdt.train_score).reshape(-1).copy()
+    return np.asarray(gbdt.valid_score_host(data_idx - 1)).reshape(-1).copy()
+
+
+def LGBM_BoosterGetFeatureNames(handle: int) -> List[str]:
+    return list(_get(handle).feature_name())
+
+
+def LGBM_BoosterPredictForCSR(handle: int, indptr, indices, data,
+                              num_col: int, predict_type: int = 0,
+                              num_iteration: int = -1):
+    return LGBM_BoosterPredictForMat(handle,
+                                     _csr_to_dense(indptr, indices, data,
+                                                   num_col),
+                                     predict_type, num_iteration)
+
+
+def LGBM_BoosterPredictForCSC(handle: int, colptr, indices, data,
+                              num_row: int, predict_type: int = 0,
+                              num_iteration: int = -1):
+    return LGBM_BoosterPredictForMat(handle,
+                                     _csc_to_dense(colptr, indices, data,
+                                                   num_row),
+                                     predict_type, num_iteration)

@@ -419,6 +419,21 @@ class GBDT:
             return self._score_dev[0]
         return jnp.reshape(self._score_dev, (-1,))
 
+    def merge_from(self, other: "GBDT") -> None:
+        """Booster::MergeFrom (c_api.cpp): append other's trees to this
+        model; scores are NOT replayed (matches the reference, which only
+        merges the model arrays).  Trees are deep-copied so later in-place
+        mutation (rollback's shrink, SetLeafValue) of one booster cannot
+        corrupt the other."""
+        import copy
+        self._materialize()
+        other._materialize()
+        merged = [copy.deepcopy(t) for t in other.models]
+        self.models.extend(merged)
+        self._models_dev.extend([None] * len(merged))
+        self._models_shrink.extend([1.0] * len(merged))
+        self.iter += len(merged) // max(other.num_tree_per_iteration, 1)
+
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:460-477)."""
         if self.iter <= 0:

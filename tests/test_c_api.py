@@ -83,3 +83,74 @@ def test_custom_update():
         p = c_api.LGBM_BoosterPredictForMat(bst, X, predict_type=1)
     acc = ((p > 0) == (y > 0)).mean()
     assert acc > 0.9
+
+
+def test_push_rows_and_sampled_column():
+    """Streaming dataset creation (LGBM_DatasetCreateFromSampledColumn +
+    PushRows, c_api.h:78-140)."""
+    X, y = make_data(seed=1)
+    n, f = X.shape
+    sample_idx = np.arange(0, n, 3)
+    sample_data = [X[sample_idx, c] for c in range(f)]
+    sample_indices = [np.arange(len(sample_idx)) for _ in range(f)]
+    h = c_api.LGBM_DatasetCreateFromSampledColumn(
+        sample_data, sample_indices, f, [len(sample_idx)] * f,
+        len(sample_idx), n, "max_bin=63 verbose=-1")
+    for start in range(0, n, 200):
+        c_api.LGBM_DatasetPushRows(h, X[start:start + 200], start)
+    assert c_api.LGBM_DatasetGetNumData(h) == n
+    c_api.LGBM_DatasetSetField(h, "label", y)
+    bst = c_api.LGBM_BoosterCreate(h, "objective=binary verbose=-1 num_leaves=15")
+    for _ in range(10):
+        c_api.LGBM_BoosterUpdateOneIter(bst)
+    pred = c_api.LGBM_BoosterPredictForMat(bst, X)
+    assert ((pred > 0.5) == (y > 0)).mean() > 0.9
+
+
+def test_subset_feature_names_and_error():
+    X, y = make_data(seed=2)
+    h = c_api.LGBM_DatasetCreateFromMat(X, "verbose=-1", label=y)
+    c_api.LGBM_DatasetSetFeatureNames(h, ["f%d" % i for i in range(6)])
+    assert c_api.LGBM_DatasetGetFeatureNames(h)[0] == "f0"
+    sub = c_api.LGBM_DatasetGetSubset(h, np.arange(100))
+    assert c_api.LGBM_DatasetGetNumData(sub) == 100
+    c_api.LGBM_SetLastError("boom")
+    assert c_api.LGBM_GetLastError() == "boom"
+    assert c_api.LGBM_APIHandleException(ValueError("x")) == -1
+    assert c_api.LGBM_GetLastError() == "x"
+
+
+def test_booster_aux_functions():
+    X, y = make_data(seed=3)
+    train = c_api.LGBM_DatasetCreateFromMat(X, "verbose=-1", label=y)
+    bst = c_api.LGBM_BoosterCreate(
+        train, "objective=binary verbose=-1 num_leaves=15 metric=auc")
+    for _ in range(5):
+        c_api.LGBM_BoosterUpdateOneIter(bst)
+    assert c_api.LGBM_BoosterGetNumFeature(bst) == 6
+    assert c_api.LGBM_BoosterGetEvalCounts(bst) == 1
+    assert c_api.LGBM_BoosterGetNumPredict(bst, 0) == len(y)
+    raw = c_api.LGBM_BoosterGetPredict(bst, 0)
+    assert raw.shape == (len(y),)
+    names = c_api.LGBM_BoosterGetFeatureNames(bst)
+    assert len(names) == 6
+    assert c_api.LGBM_BoosterCalcNumPredict(bst, 10, 0) == 10
+    assert c_api.LGBM_BoosterCalcNumPredict(bst, 10, 2) == 50
+    # CSR predict
+    indptr, indices, data = [0], [], []
+    for r in range(20):
+        for ci in range(6):
+            if X[r, ci] != 0:
+                indices.append(ci); data.append(X[r, ci])
+        indptr.append(len(indices))
+    p_csr = c_api.LGBM_BoosterPredictForCSR(bst, indptr, indices, data, 6)
+    p_mat = c_api.LGBM_BoosterPredictForMat(bst, X[:20])
+    np.testing.assert_allclose(p_csr, p_mat, rtol=1e-12)
+    # reset_parameter takes effect on shrinkage
+    c_api.LGBM_BoosterResetParameter(bst, "learning_rate=0.5")
+    # merge two boosters
+    bst2 = c_api.LGBM_BoosterCreate(train, "objective=binary verbose=-1 num_leaves=7")
+    c_api.LGBM_BoosterUpdateOneIter(bst2)
+    n_before = c_api.LGBM_BoosterGetCurrentIteration(bst)
+    c_api.LGBM_BoosterMerge(bst, bst2)
+    assert c_api.LGBM_BoosterGetCurrentIteration(bst) == n_before + 1
