@@ -214,7 +214,8 @@ class GBDT:
             ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
             self._score_dev = self._score_dev.at[tid].set(
                 dev_predict.add_tree_to_score(self._score_dev[tid],
-                                              self.learner.X, ta,
+                                              self.learner.X[:self.num_data],
+                                              ta,
                                               jnp.asarray(scale, self.score_dtype),
                                               self.learner.bundle_arrays))
         elif self.train_data.raw_data is not None:

@@ -76,6 +76,40 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  psum_axis: str = None, feature_axis: str = None,
                  voting_k: int = 0, num_voting_machines: int = 1,
                  bundle: BundleArrays = None, group_bins: int = 0):
+    """Bind `meta`/`bundle` onto the shared memoized grow program.
+
+    The heavy lifting lives in `make_grow_core`, which is cached on the
+    STATIC configuration only — two boosters (e.g. cv() folds) with the
+    same shapes share one compiled XLA program instead of paying a fresh
+    ~30s trace+compile each (meta/bundle arrays are call-time arguments
+    of the cached function, not closure constants).
+    """
+    core = make_grow_core(num_leaves, num_bins, params, max_depth,
+                          hist_mode, hist_dtype, psum_axis, feature_axis,
+                          voting_k, num_voting_machines,
+                          bundle is not None, group_bins)
+
+    def grow(X, grad, hess, row_mult, feature_mask):
+        return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
+
+    grow.core = core
+    return grow
+
+
+@functools.lru_cache(maxsize=64)
+def make_grow_jit(*static_args):
+    """jit(make_grow_core(...)) cached on the same static key, so repeated
+    boosters/folds reuse one compiled executable, not just one traceable."""
+    return jax.jit(make_grow_core(*static_args))
+
+
+@functools.lru_cache(maxsize=64)
+def make_grow_core(num_leaves: int, num_bins: int,
+                   params: SplitParams, max_depth: int,
+                   hist_mode: str = "scatter", hist_dtype=jnp.float32,
+                   psum_axis: str = None, feature_axis: str = None,
+                   voting_k: int = 0, num_voting_machines: int = 1,
+                   has_bundle: bool = False, group_bins: int = 0):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -98,10 +132,10 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
     """
     L = num_leaves
     voting = voting_k > 0 and psum_axis is not None
-    if bundle is not None and feature_axis is not None:
+    if has_bundle and feature_axis is not None:
         raise ValueError("EFB bundling is not supported with the "
                          "feature-parallel learner (set enable_bundle=false)")
-    hist_bins = group_bins if bundle is not None else num_bins
+    hist_bins = group_bins if has_bundle else num_bins
 
     if hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot, num_bins=hist_bins)
@@ -116,10 +150,10 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         Log.fatal("Unknown tpu_histogram_mode %s "
                   "(expected auto/scatter/onehot/pallas)", hist_mode)
 
-    def to_feature_hist(ghist, sums):
+    def to_feature_hist(ghist, sums, meta, bundle):
         """Group histograms -> per-feature (F, B, 3) views with the default
         bin rebuilt by subtraction (FixHistogram, dataset.cpp:764-783)."""
-        if bundle is None:
+        if not has_bundle:
             return ghist
         flat = ghist.reshape(-1, 3)
         v = flat[bundle.gather_idx] * bundle.valid_mask[..., None].astype(
@@ -150,8 +184,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
             b = b.at[GAIN].set(jnp.where(depth < max_depth, b[GAIN], -jnp.inf))
         return b
 
-    def best_of_serial(hist, sums, feature_mask, depth):
-        b = find_best_split_impl(to_feature_hist(hist, sums),
+    def best_of_serial(hist, sums, feature_mask, depth, meta, bundle):
+        b = find_best_split_impl(to_feature_hist(hist, sums, meta, bundle),
                                  sums[0], sums[1], sums[2], meta,
                                  feature_mask, params)
         return depth_gate(b, depth)
@@ -173,11 +207,12 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
             best = jnp.where(take, gathered[i], best)
         return depth_gate(best, depth)
 
-    def best_of_voting(ghist_local, sums, feature_mask, depth):
+    def best_of_voting(ghist_local, sums, feature_mask, depth, meta,
+                       bundle):
         # local candidates against LOCAL leaf sums with constraints divided
         # by num_machines (voting_parallel_tree_learner.cpp:54-56)
         local_sums = jnp.sum(ghist_local[0], axis=0)    # (3,) of this shard
-        hist_local = to_feature_hist(ghist_local, local_sums)
+        hist_local = to_feature_hist(ghist_local, local_sums, meta, bundle)
         F = hist_local.shape[0]
         k = min(voting_k, F)
         cand, _, _, _, local_shift = per_feature_candidates(
@@ -208,7 +243,7 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
         b = b.at[FEATURE].set(sel[f_local].astype(b.dtype))
         return depth_gate(b, depth)
 
-    def grow(X, grad, hess, row_mult, feature_mask):
+    def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
         n = X.shape[0]
         grad = grad.astype(hist_dtype)
         hess = hess.astype(hist_dtype)
@@ -236,9 +271,11 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
             def best_of(h, s, m, d):
                 return best_of_feature_parallel(h, s, m, d, local_meta, offset)
         elif voting:
-            best_of = best_of_voting
+            def best_of(h, s, m, d):
+                return best_of_voting(h, s, m, d, meta, bundle)
         else:
-            best_of = best_of_serial
+            def best_of(h, s, m, d):
+                return best_of_serial(h, s, m, d, meta, bundle)
 
         root_sums = maybe_psum(jnp.stack([
             jnp.sum(grad * row_mult), jnp.sum(hess * row_mult),
@@ -300,7 +337,7 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                 own = (f >= offset) & (f < offset + F_local)
                 fl = jnp.clip(f - offset, 0, F_local - 1)
                 col = jnp.take(X, fl, axis=1).astype(jnp.int32)
-            elif bundle is not None:
+            elif has_bundle:
                 # group column -> feature-local bins (feature_group.h
                 # PushData inverted); out-of-range rows sit at the default
                 gcol = jnp.take(X, bundle.group_of[f], axis=1).astype(

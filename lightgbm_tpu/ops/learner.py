@@ -71,7 +71,21 @@ class SerialTreeLearner:
         self.num_leaves = config.num_leaves
         self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
         self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
-        self.X = device_data if device_data is not None else jnp.asarray(train_data.binned)
+        # round rows up to a quantum so nearby dataset sizes (cv folds,
+        # retrains after appending data) land on the same compiled shape;
+        # padded rows carry zero row_mult and change nothing
+        self._row_pad = 0
+        if device_data is not None:
+            self.X = device_data
+        else:
+            binned = train_data.binned
+            n = binned.shape[0]
+            self._row_pad = (-n) % 1024
+            if self._row_pad:
+                binned = np.concatenate(
+                    [binned, np.zeros((self._row_pad, binned.shape[1]),
+                                      binned.dtype)])
+            self.X = jnp.asarray(binned)
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(train_data.num_bin_arr),
             default_bin=jnp.asarray(train_data.default_bin_arr),
@@ -87,14 +101,36 @@ class SerialTreeLearner:
             hist_mode = ("onehot" if jax.default_backend() == "tpu"
                          else "scatter")
         self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
-        grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
-                            self.params, config.max_depth,
-                            hist_mode=hist_mode, hist_dtype=self.dtype,
-                            psum_axis=psum_axis,
-                            bundle=self.bundle_arrays,
-                            group_bins=self.group_bins)
-        self._grow = jax.jit(grow) if psum_axis is None else grow
-        self._ones = jnp.ones(train_data.num_data, self.dtype)
+        if psum_axis is None:
+            # cached jitted core: a second booster/fold with the same
+            # static config reuses the compiled executable (meta/bundle
+            # are call-time args, ops/grow.py make_grow_jit)
+            from .grow import make_grow_jit
+            core = make_grow_jit(self.num_leaves, self.num_bins,
+                                 self.params, config.max_depth, hist_mode,
+                                 self.dtype, None, None, 0, 1,
+                                 self.bundle_arrays is not None,
+                                 self.group_bins)
+            meta, bund = self.meta, self.bundle_arrays
+
+            def _grow(X, g, h, rm, m, _core=core, _meta=meta, _bund=bund):
+                return _core(X, g, h, rm, m, _meta, _bund)
+
+            self._grow = _grow
+        else:
+            self._grow = make_grow_fn(self.num_leaves, self.num_bins,
+                                      self.meta, self.params,
+                                      config.max_depth, hist_mode=hist_mode,
+                                      hist_dtype=self.dtype,
+                                      psum_axis=psum_axis,
+                                      bundle=self.bundle_arrays,
+                                      group_bins=self.group_bins)
+        if self._row_pad:
+            self._ones = jnp.concatenate(
+                [jnp.ones(train_data.num_data, self.dtype),
+                 jnp.zeros(self._row_pad, self.dtype)])
+        else:
+            self._ones = jnp.ones(train_data.num_data, self.dtype)
         self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
         # feature_fraction RNG persists across trees
         # (serial_tree_learner.cpp:40-96 Init + :257-275 BeforeTrain)
@@ -119,11 +155,23 @@ class SerialTreeLearner:
             row_mult = self._ones
         else:
             row_mult = jnp.asarray(row_mult, self.dtype)
+            if self._row_pad:
+                row_mult = jnp.concatenate(
+                    [row_mult, jnp.zeros(self._row_pad, self.dtype)])
         if feature_mask is None:
             feature_mask = self.sample_feature_mask()
         grad = jnp.asarray(grad, self.dtype)
         hess = jnp.asarray(hess, self.dtype)
-        return self._grow(self.X, grad, hess, row_mult, feature_mask)
+        if self._row_pad:
+            grad = jnp.concatenate(
+                [grad, jnp.zeros(self._row_pad, self.dtype)])
+            hess = jnp.concatenate(
+                [hess, jnp.zeros(self._row_pad, self.dtype)])
+        tree, leaf_id = self._grow(self.X, grad, hess, row_mult,
+                                   feature_mask)
+        if self._row_pad:
+            leaf_id = leaf_id[:self.train_data.num_data]
+        return tree, leaf_id
 
     def train(self, grad, hess, row_mult=None) -> Tuple[Tree, jnp.ndarray]:
         dev_tree, leaf_id = self.train_device(grad, hess, row_mult)
