@@ -1,0 +1,33 @@
+# Smoke test for the lightgbm_tpu R bridge (run: Rscript tests/smoke.R).
+# Mirrors the reference R-package test style (R-package/tests/) at the
+# smallest useful scale: Dataset -> train -> predict -> save/load round-trip.
+source(file.path(dirname(sub("--file=", "", grep("--file=", commandArgs(FALSE),
+                                                 value = TRUE))), "..", "R",
+                 "lightgbm_tpu.R"))
+
+set.seed(42)
+n <- 400
+x <- matrix(rnorm(n * 4), ncol = 4)
+y <- as.numeric(x[, 1] + 0.5 * x[, 2] > 0)
+
+dtrain <- lgb.Dataset(x, label = y)
+bst <- lgb.train(params = list(objective = "binary", num_leaves = 7,
+                               learning_rate = 0.2, verbose = -1),
+                 data = dtrain, nrounds = 20L)
+
+pred <- predict.lgb.Booster(bst, x)
+stopifnot(length(pred) == n)
+acc <- mean((pred > 0.5) == (y > 0.5))
+cat(sprintf("train accuracy: %.3f\n", acc))
+stopifnot(acc > 0.9)
+
+f <- tempfile(fileext = ".txt")
+lgb.save(bst, f)
+bst2 <- lgb.load(filename = f)
+pred2 <- predict.lgb.Booster(bst2, x)
+stopifnot(max(abs(pred - pred2)) < 1e-9)
+
+imp <- lgb.importance(bst)
+stopifnot(length(imp) == 4)
+
+cat("R smoke test OK\n")

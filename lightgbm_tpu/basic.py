@@ -94,11 +94,19 @@ class Dataset:
                 for c in spec:
                     if isinstance(c, str):
                         # column-name spec (basic.py:224-291 pandas path
-                        # semantics): resolve against feature names
+                        # semantics): resolve against explicit feature names
+                        # or, with feature_name='auto', the generated
+                        # Column_%d names — never silently drop the spec
                         if feature_names and c in feature_names:
                             cat.append(feature_names.index(c))
+                        elif not feature_names and c.startswith("Column_") \
+                                and c[len("Column_"):].isdigit():
+                            cat.append(int(c[len("Column_"):]))
                         else:
-                            Log.warning("Unknown categorical column %s", c)
+                            raise LightGBMError(
+                                "Unknown categorical column %r (known "
+                                "feature names: %s)"
+                                % (c, feature_names or "auto Column_<i>"))
                     else:
                         cat.append(int(c))
             ref_td = None
@@ -366,12 +374,28 @@ class Booster:
         return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
 
     def reset_parameter(self, params: dict) -> "Booster":
-        """LGBM_BoosterResetParameter semantics: learning_rate applies to
-        the running engine immediately; other params are recorded."""
+        """LGBM_BoosterResetParameter semantics: rebuild the running config
+        like GBDT::ResetConfig.  learning_rate alone takes a fast path (it
+        is read every iteration anyway); any other key rebuilds the tree
+        learner from the updated full parameter set so num_leaves,
+        lambda_l1/l2, bagging, etc. actually take effect."""
         params = dict(params or {})
         self.params.update(params)
         if "learning_rate" in params:
             self._gbdt.shrinkage_rate = float(params["learning_rate"])
+        rest = [k for k in params if k != "learning_rate"]
+        if rest:
+            if "objective" in rest:
+                raise LightGBMError(
+                    "Cannot change objective during training; "
+                    "create a new Booster instead")
+            cfg = Config(dict(self.params))
+            gb = self._gbdt
+            if gb.train_data is not None:
+                gb.reset_training_data(cfg, gb.train_data, gb.objective,
+                                       gb.training_metrics)
+            else:
+                gb.config = cfg
         return self
 
     def set_train_data(self, train_set: "Dataset") -> "Booster":

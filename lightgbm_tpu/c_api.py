@@ -301,8 +301,23 @@ def LGBM_DatasetPushRows(handle: int, data, start_row: int = -1) -> int:
     chunk = np.asarray(data, dtype=np.float64)
     if start_row is None or start_row < 0:
         start_row = sum(len(c) for _, c in ds["rows"])
-    ds["rows"].append((int(start_row), chunk))
-    if sum(len(c) for _, c in ds["rows"]) >= ds["num_total_row"]:
+    start_row = int(start_row)
+    n_total = ds["num_total_row"]
+    if start_row + len(chunk) > n_total:
+        raise LightGBMError(
+            "PushRows chunk [%d, %d) exceeds num_total_row=%d"
+            % (start_row, start_row + len(chunk), n_total))
+    # finalize only when every row is covered exactly once — a duplicate
+    # start_row must not trigger premature finalization with zero-filled
+    # holes, nor silently overwrite previously pushed rows
+    covered = ds.setdefault("covered", np.zeros(n_total, dtype=bool))
+    if covered[start_row:start_row + len(chunk)].any():
+        raise LightGBMError(
+            "PushRows chunk [%d, %d) overlaps previously pushed rows"
+            % (start_row, start_row + len(chunk)))
+    covered[start_row:start_row + len(chunk)] = True
+    ds["rows"].append((start_row, chunk))
+    if covered.all():
         _finish_push(handle, ds)
     return 0
 

@@ -2,7 +2,8 @@
 
 Usage:  python -m lightgbm_tpu config=train.conf [key=value ...]
 CLI args override the config file (application.cpp:48-104).  Tasks: train,
-predict (convert_model is accepted and routed to the JSON dump for now).
+predict, convert_model (emits compiled C++ if-else code like
+GBDT::ModelToIfElse, or PMML — see run_convert_model).
 Snapshots every ``snapshot_freq`` iterations (application.cpp:237-241).
 """
 from __future__ import annotations

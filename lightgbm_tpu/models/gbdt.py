@@ -421,19 +421,23 @@ class GBDT:
         return jnp.reshape(self._score_dev, (-1,))
 
     def merge_from(self, other: "GBDT") -> None:
-        """Booster::MergeFrom (c_api.cpp): append other's trees to this
-        model; scores are NOT replayed (matches the reference, which only
-        merges the model arrays).  Trees are deep-copied so later in-place
+        """GBDT::MergeFrom (gbdt.h:47-62): the other model's trees come
+        FIRST (as if this booster had been continued-trained from the other
+        model), and the merged prefix becomes the init-iteration count.
+        Scores are NOT replayed (matches the reference, which only merges
+        the model arrays).  Trees are deep-copied so later in-place
         mutation (rollback's shrink, SetLeafValue) of one booster cannot
         corrupt the other."""
         import copy
         self._materialize()
         other._materialize()
         merged = [copy.deepcopy(t) for t in other.models]
-        self.models.extend(merged)
-        self._models_dev.extend([None] * len(merged))
-        self._models_shrink.extend([1.0] * len(merged))
-        self.iter += len(merged) // max(other.num_tree_per_iteration, 1)
+        self.models = merged + self.models
+        self._models_dev = [None] * len(merged) + self._models_dev
+        self._models_shrink = [1.0] * len(merged) + self._models_shrink
+        k = max(self.num_tree_per_iteration, 1)
+        self.num_init_iteration = len(merged) // k
+        self.num_iteration_for_pred = len(self.models) // k
 
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:460-477)."""

@@ -3,9 +3,10 @@
 Parity targets: src/objective/regression_objective.hpp,
 binary_objective.hpp, multiclass_objective.hpp, rank_objective.hpp and the
 factory in src/objective/objective_function.cpp:9-56.  Elementwise objectives
-are jnp expressions (fused by XLA into the boosting step); lambdarank keeps
-the reference's per-query pairwise semantics, vectorized per query on host
-(device version via padded vmap is a planned optimization).
+are jnp expressions (fused by XLA into the boosting step); lambdarank runs
+the reference's per-query pairwise semantics fully on device as a jitted
+vmap over padded query segments (the numpy per-query path is kept as the
+test oracle, get_gradients_host).
 
 Multi-class score layout matches the reference: column-major per class, i.e.
 ``score[k * num_data + i]`` (multiclass_objective.hpp:60-75); arrays here are
@@ -13,9 +14,11 @@ shaped (num_class, num_data) with the same meaning.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .io.metadata import Metadata
@@ -368,8 +371,60 @@ class LambdarankNDCG(ObjectiveFunction):
             lab = self.labels_np[self.qb[q]:self.qb[q + 1]]
             m = _max_dcg_at_k(self.optimize_pos_at, lab, self.label_gain)
             self.inverse_max_dcgs[q] = 1.0 / m if m > 0.0 else m
+        self._build_device_layout()
+
+    def _build_device_layout(self) -> None:
+        """Padded per-query layout for the jitted gradient program.
+
+        Every query becomes one row of a (num_queries, Qmax) table; rows are
+        contiguous ranges of the score vector (query_boundaries), so the
+        result is read back with a single N-element gather instead of a
+        scatter.  This is the `vmap over padded query segments` design of
+        SURVEY.md §7 step 4 replacing rank_objective.hpp:19-244's per-query
+        OMP loop.
+        """
+        counts = np.diff(self.qb)
+        qmax = max(int(counts.max()) if len(counts) else 1, 2)
+        nq = self.num_queries
+        slot = np.arange(qmax)[None, :]
+        self._dev_valid = jnp.asarray(slot < counts[:, None])
+        idx = self.qb[:-1, None] + slot                  # (Q, qmax)
+        idx = np.minimum(idx, self.num_data - 1)         # clamp padding
+        self._dev_idx = jnp.asarray(idx.astype(np.int32))
+        self._dev_labels = jnp.asarray(
+            np.where(slot < counts[:, None],
+                     self.labels_np[idx].astype(np.int32), 0))
+        self._dev_counts = jnp.asarray(counts.astype(np.int32))
+        self._dev_inv_max_dcg = jnp.asarray(
+            self.inverse_max_dcgs.astype(np.float32))
+        self._dev_discounts = jnp.asarray(
+            get_discounts(qmax).astype(np.float32))
+        self._dev_label_gain = jnp.asarray(self.label_gain.astype(np.float32))
+        # inverse map: row i of the score vector -> (its query, offset)
+        rq = np.repeat(np.arange(nq, dtype=np.int64), counts)
+        ro = np.arange(self.num_data, dtype=np.int64) - self.qb[:-1][rq]
+        self._dev_flat_back = jnp.asarray((rq * qmax + ro).astype(np.int32))
+        # block the query axis so the pairwise (qmax, qmax) tensors stay
+        # bounded: ~64MB of f32 pair matrices per block
+        blk = max(1, min(nq, int(16_000_000 // (qmax * qmax)) or 1))
+        self._dev_block = blk
+        self._dev_sigmoid = float(self.sigmoid)
 
     def get_gradients(self, score):
+        """Jitted padded-query lambdas — no host round-trip per iteration.
+
+        The numpy implementation (get_gradients_host) is kept as the oracle
+        for tests/test_objectives parity checks.
+        """
+        lam, hes = _lambdarank_device(
+            jnp.asarray(score, jnp.float32), self._dev_idx, self._dev_valid,
+            self._dev_labels, self._dev_counts, self._dev_inv_max_dcg,
+            self._dev_discounts, self._dev_label_gain, self._dev_flat_back,
+            self._dev_sigmoid, self._dev_block)
+        return _apply_weights(lam, hes, self.weights)
+
+    def get_gradients_host(self, score):
+        """Reference-shaped numpy path (rank_objective.hpp:100-190)."""
         score = np.asarray(score, dtype=np.float64)
         lambdas = np.zeros(self.num_data, dtype=np.float32)
         hessians = np.zeros(self.num_data, dtype=np.float32)
@@ -414,6 +469,77 @@ class LambdarankNDCG(ObjectiveFunction):
         hes = p_hess.sum(axis=1) + p_hess.sum(axis=0)
         out_l[sorted_idx] += lam.astype(np.float32)
         out_h[sorted_idx] += hes.astype(np.float32)
+
+
+def _lambdarank_one_query(s, labels, cnt, inv_max_dcg, discounts,
+                          label_gain, sigmoid):
+    """Pairwise lambdas for ONE padded query (rank_objective.hpp:100-190).
+
+    s: (qmax,) scores with padding at -inf; labels: (qmax,) int32;
+    cnt: scalar real count.  Returns (lam, hes) in ORIGINAL segment order.
+    """
+    sorted_idx = jnp.argsort(-s)                   # stable: ties keep order
+    rs = s[sorted_idx]
+    rl = labels[sorted_idx]
+    gains = label_gain[rl]
+    finite = jnp.isfinite(rs)
+    valid = (rl[:, None] > rl[None, :]) & finite[:, None] & finite[None, :]
+    delta_score = rs[:, None] - rs[None, :]
+    dcg_gap = gains[:, None] - gains[None, :]
+    paired_discount = jnp.abs(discounts[:, None] - discounts[None, :])
+    delta_ndcg = dcg_gap * paired_discount * inv_max_dcg
+    best_score = rs[0]
+    wi = jnp.maximum(cnt - 1, 0)
+    wi = jnp.where((wi > 0) & jnp.isneginf(rs[wi]), wi - 1, wi)
+    worst_score = rs[wi]
+    norm = jnp.where(best_score != worst_score,
+                     1.0 / (0.01 + jnp.abs(delta_score)), 1.0)
+    delta_ndcg = delta_ndcg * norm
+    p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * delta_score * sigmoid))
+    p_hess = p_lambda * (2.0 - p_lambda)
+    p_lambda = jnp.where(valid, -p_lambda * delta_ndcg, 0.0)
+    p_hess = jnp.where(valid, 2.0 * p_hess * delta_ndcg, 0.0)
+    lam = jnp.sum(p_lambda, axis=1) - jnp.sum(p_lambda, axis=0)
+    hes = jnp.sum(p_hess, axis=1) + jnp.sum(p_hess, axis=0)
+    live = (cnt > 1) & (inv_max_dcg > 0.0)
+    lam = jnp.where(live, lam, 0.0)
+    hes = jnp.where(live, hes, 0.0)
+    inv = jnp.argsort(sorted_idx)                  # unsort to segment order
+    return lam[inv], hes[inv]
+
+
+@functools.partial(jax.jit, static_argnums=(9, 10))
+def _lambdarank_device(score, idx, valid, labels, counts, inv_max_dcg,
+                       discounts, label_gain, flat_back, sigmoid,
+                       block):
+    from jax import lax
+    nq, qmax = idx.shape
+    s = jnp.where(valid, score[idx].astype(jnp.float32), -jnp.inf)
+    pad_q = (-nq) % block
+    if pad_q:
+        zpadi = lambda a: jnp.concatenate(
+            [a, jnp.zeros((pad_q,) + a.shape[1:], a.dtype)])
+        s = jnp.concatenate([s, jnp.full((pad_q, qmax), -jnp.inf, s.dtype)])
+        labels = zpadi(labels)
+        counts = zpadi(counts)
+        inv_max_dcg = zpadi(inv_max_dcg)
+    nb = (nq + pad_q) // block
+
+    per_query = jax.vmap(_lambdarank_one_query,
+                         in_axes=(0, 0, 0, 0, None, None, None))
+
+    def one_block(args):
+        sb, lb, cb, ib = args
+        return per_query(sb, lb, cb, ib, discounts, label_gain, sigmoid)
+
+    lam, hes = lax.map(one_block,
+                       (s.reshape(nb, block, qmax),
+                        labels.reshape(nb, block, qmax),
+                        counts.reshape(nb, block),
+                        inv_max_dcg.reshape(nb, block)))
+    lam = lam.reshape(-1)[flat_back]               # (N,) gather-back
+    hes = hes.reshape(-1)[flat_back]
+    return lam, hes
 
 
 def _max_dcg_at_k(k: int, label: np.ndarray, label_gain: np.ndarray) -> float:

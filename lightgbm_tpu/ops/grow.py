@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .histogram import leaf_histogram_onehot, leaf_histogram_scatter
+from .histogram import (compact_rows, compact_rows_topk, gathered_histogram,
+                        leaf_histogram_onehot, leaf_histogram_scatter)
 from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
@@ -70,12 +71,30 @@ class TreeArrays(NamedTuple):
     leaf_depth: jnp.ndarray          # (L,) i32
 
 
+def default_row_capacities(n: int, min_capacity: int = 2048,
+                           max_tiers: int = 10):
+    """Descending static row-gather capacities n, n/2, n/4, ... — the tier
+    ladder for compacted leaf histograms.  The top tier is full-N (under a
+    data mesh a shard can hold ALL its local rows of the globally-smaller
+    child), lower tiers bound wasted work to <2x the leaf's true row count
+    until the ladder bottoms out."""
+    caps = []
+    c = int(n)
+    while len(caps) < max_tiers:
+        caps.append(c)
+        if c <= min_capacity or c <= 1:
+            break
+        c = (c + 1) // 2
+    return tuple(caps)
+
+
 def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  params: SplitParams, max_depth: int,
                  hist_mode: str = "scatter", hist_dtype=jnp.float32,
                  psum_axis: str = None, feature_axis: str = None,
                  voting_k: int = 0, num_voting_machines: int = 1,
-                 bundle: BundleArrays = None, group_bins: int = 0):
+                 bundle: BundleArrays = None, group_bins: int = 0,
+                 row_capacities: tuple = (), cache_hists: bool = True):
     """Bind `meta`/`bundle` onto the shared memoized grow program.
 
     The heavy lifting lives in `make_grow_core`, which is cached on the
@@ -87,7 +106,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
     core = make_grow_core(num_leaves, num_bins, params, max_depth,
                           hist_mode, hist_dtype, psum_axis, feature_axis,
                           voting_k, num_voting_machines,
-                          bundle is not None, group_bins)
+                          bundle is not None, group_bins,
+                          row_capacities, cache_hists)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -109,7 +129,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                    hist_mode: str = "scatter", hist_dtype=jnp.float32,
                    psum_axis: str = None, feature_axis: str = None,
                    voting_k: int = 0, num_voting_machines: int = 1,
-                   has_bundle: bool = False, group_bins: int = 0):
+                   has_bundle: bool = False, group_bins: int = 0,
+                   row_capacities: tuple = (), cache_hists: bool = True):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -136,6 +157,12 @@ def make_grow_core(num_leaves: int, num_bins: int,
         raise ValueError("EFB bundling is not supported with the "
                          "feature-parallel learner (set enable_bundle=false)")
     hist_bins = group_bins if has_bundle else num_bins
+    # Pallas kernels take the full-N mask form; gathering only applies to
+    # the onehot/scatter kernels.
+    use_gather = len(row_capacities) > 0 and hist_mode != "pallas"
+    # TPU: sort-based compaction (scatter ~8ms + cumsum ~2.4ms vs top_k
+    # ~3.4ms at 1M rows, measured); CPU: cumsum+scatter is cheaper.
+    compact_mode = "topk" if jax.default_backend() == "tpu" else "scatter"
 
     if hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot, num_bins=hist_bins)
@@ -167,8 +194,36 @@ def make_grow_core(num_leaves: int, num_bins: int,
             return lax.psum(x, psum_axis)
         return x
 
+    def local_hist(X, g, h, leaf_id, leaf, row_mult):
+        """This shard's histogram of `leaf` — gathered when capacities are
+        configured (O(rows_in_leaf) like dense_bin.hpp:66-98), else the
+        legacy full-N masked scan."""
+        if not use_gather:
+            return hist_fn(X, g, h, leaf_id, leaf, row_mult)
+        mask = leaf_id == leaf
+        count = jnp.sum(mask.astype(jnp.int32))
+        if compact_mode == "scatter":
+            pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        caps = jnp.asarray(row_capacities, jnp.int32)    # descending
+        tier = jnp.clip(jnp.sum(caps >= count) - 1, 0,
+                        len(row_capacities) - 1)
+
+        def tier_branch(c):
+            def run(_):
+                if compact_mode == "scatter":
+                    idx = compact_rows(mask, pos, c)
+                else:
+                    idx = compact_rows_topk(mask, c)
+                valid = jnp.arange(c, dtype=jnp.int32) < count
+                return gathered_histogram(X, g, h, row_mult, idx, valid,
+                                          hist_bins, hist_mode)
+            return run
+
+        return lax.switch(tier, [tier_branch(c) for c in row_capacities],
+                          None)
+
     def hist_of_leaf(X, g, h, leaf_id, leaf, row_mult):
-        h_local = hist_fn(X, g, h, leaf_id, leaf, row_mult)
+        h_local = local_hist(X, g, h, leaf_id, leaf, row_mult)
         if voting:
             return h_local          # voting: keep local, psum only top-k
         return maybe_psum(h_local)
@@ -284,7 +339,14 @@ def make_grow_core(num_leaves: int, num_bins: int,
 
         F = hist0.shape[0]
         B = hist0.shape[1]
-        hists = jnp.zeros((L, F, B, 3), dtype=hist_dtype).at[0].set(hist0)
+        if cache_hists:
+            hists = jnp.zeros((L, F, B, 3), dtype=hist_dtype).at[0].set(hist0)
+        else:
+            # HistogramPool disabled (histogram_pool_size budget exceeded):
+            # no per-leaf cache, larger children are re-scanned instead of
+            # obtained by parent subtraction — memory O(F*B*3) instead of
+            # O(L*F*B*3), the recompute arm of feature_histogram.hpp:398-565.
+            hists = jnp.zeros((0,), dtype=hist_dtype)
         bests = jnp.full((L, SPLIT_VEC_SIZE), -jnp.inf, dtype=hist_dtype)
         bests = bests.at[0].set(best_of(hist0, root_sums, feature_mask, 0))
         sums = jnp.zeros((L, 3), dtype=hist_dtype).at[0].set(root_sums)
@@ -414,9 +476,16 @@ def make_grow_core(num_leaves: int, num_bins: int,
             large_sums = jnp.where(left_smaller, right_sums, left_sums)
 
             hist_small = hist_of_leaf(X, grad, hess, leaf_id, small, row_mult)
-            hist_large = hists[best_leaf] - hist_small
-            hists = hists.at[small].set(jnp.where(ok, hist_small, hists[small]))
-            hists = hists.at[large].set(jnp.where(ok, hist_large, hists[large]))
+            if cache_hists:
+                # larger child by parent subtraction (feature_histogram.hpp:63)
+                hist_large = hists[best_leaf] - hist_small
+                hists = hists.at[small].set(
+                    jnp.where(ok, hist_small, hists[small]))
+                hists = hists.at[large].set(
+                    jnp.where(ok, hist_large, hists[large]))
+            else:
+                hist_large = hist_of_leaf(X, grad, hess, leaf_id, large,
+                                          row_mult)
             sums = sums.at[small].set(jnp.where(ok, small_sums, sums[small]))
             sums = sums.at[large].set(jnp.where(ok, large_sums, sums[large]))
 

@@ -22,8 +22,38 @@ from ..io.dataset import TrainingData
 from ..models.tree import Tree
 from ..utils.config import Config
 from ..utils.random import Random
-from .grow import BundleArrays, TreeArrays, make_grow_fn
+from .grow import (BundleArrays, TreeArrays, default_row_capacities,
+                   make_grow_fn)
 from .split_finder import FeatureMeta, SplitParams
+from ..utils.log import Log
+
+# auto histogram-cache budget when histogram_pool_size is unset (-1): the
+# reference's default is unlimited, but an Epsilon-shaped cache
+# (L=255,F=2000,B=255 ~ 1.5GB) per booster is an HBM hazard on shared
+# chips, so above this we fall back to recompute instead of subtraction
+_AUTO_HIST_CACHE_MB = 2048.0
+
+
+def hist_cache_enabled(config: Config, num_leaves: int, num_cols: int,
+                       num_bins: int, dtype_bytes: int) -> bool:
+    """HistogramPool policy (feature_histogram.hpp:398-565): cache per-leaf
+    histograms (enabling larger-child-by-subtraction) only while the
+    (L, F, B, 3) cache fits the histogram_pool_size budget; otherwise
+    recompute both children and warn with the number."""
+    need_mb = (num_leaves * max(num_cols, 1) * max(num_bins, 2) * 3
+               * dtype_bytes) / 1e6
+    budget = float(config.histogram_pool_size)
+    if budget <= 0:
+        budget = _AUTO_HIST_CACHE_MB
+    if need_mb <= budget:
+        return True
+    Log.warning(
+        "Histogram cache would need %.0f MB (num_leaves=%d x %d columns x "
+        "%d bins x 3 x %dB) > histogram_pool_size budget %.0f MB; disabling "
+        "the per-leaf histogram cache (children are recomputed instead of "
+        "obtained by subtraction).", need_mb, num_leaves, num_cols,
+        num_bins, dtype_bytes, budget)
+    return False
 
 
 def build_bundle_arrays(train_data: TrainingData):
@@ -101,6 +131,25 @@ class SerialTreeLearner:
             hist_mode = ("onehot" if jax.default_backend() == "tpu"
                          else "scatter")
         self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
+        self.hist_mode = hist_mode
+        ncols = (len(train_data.bundle.num_group_bins)
+                 if train_data.bundle is not None
+                 else max(train_data.num_features, 1))
+        nbins = self.group_bins if train_data.bundle is not None \
+            else self.num_bins
+        self.cache_hists = hist_cache_enabled(
+            config, self.num_leaves, ncols, nbins,
+            8 if config.tpu_use_dp else 4)
+        # Gather-compacted leaf histograms (O(rows_in_leaf), capacity tiers)
+        # pay off when the per-row histogram work dwarfs the fixed O(N)
+        # compaction cost: always on CPU (compaction is cheap there); on
+        # TPU only for wide histograms — at F*B ~ 1764 (Higgs 28x63) the
+        # masked one-hot pass (~2.4ms at 1M rows) is CHEAPER than one
+        # top_k compaction (~3.4ms), so small shapes keep the masked scan.
+        gather_pays = (jax.default_backend() != "tpu"
+                       or ncols * nbins >= 4096)
+        self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
+                               if gather_pays else ())
         if psum_axis is None:
             # cached jitted core: a second booster/fold with the same
             # static config reuses the compiled executable (meta/bundle
@@ -110,7 +159,8 @@ class SerialTreeLearner:
                                  self.params, config.max_depth, hist_mode,
                                  self.dtype, None, None, 0, 1,
                                  self.bundle_arrays is not None,
-                                 self.group_bins)
+                                 self.group_bins, self.row_capacities,
+                                 self.cache_hists)
             meta, bund = self.meta, self.bundle_arrays
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta, _bund=bund):
@@ -124,7 +174,9 @@ class SerialTreeLearner:
                                       hist_dtype=self.dtype,
                                       psum_axis=psum_axis,
                                       bundle=self.bundle_arrays,
-                                      group_bins=self.group_bins)
+                                      group_bins=self.group_bins,
+                                      row_capacities=self.row_capacities,
+                                      cache_hists=self.cache_hists)
         if self._row_pad:
             self._ones = jnp.concatenate(
                 [jnp.ones(train_data.num_data, self.dtype),

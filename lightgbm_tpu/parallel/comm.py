@@ -59,6 +59,7 @@ def run_ranks(size: int, fn):
     """
     import threading
 
+    _BARRIER_TIMEOUT = 120.0     # seconds; generous for CI boxes
     deposits = {}
     results: List[Any] = [None] * size
     errors: List[Any] = [None] * size
@@ -81,9 +82,12 @@ def run_ranks(size: int, fn):
             i = self._round
             self._round += 1
             deposits.setdefault(i, [None] * size)[self._rank] = obj
-            barrier.wait()
+            # timeout -> BrokenBarrierError in every waiter, so a rank that
+            # skips a collective (or crashes) fails the test loudly instead
+            # of deadlocking join() forever
+            barrier.wait(timeout=_BARRIER_TIMEOUT)
             out = list(deposits[i])
-            barrier.wait()               # keep rounds from overlapping
+            barrier.wait(timeout=_BARRIER_TIMEOUT)   # keep rounds separate
             return out
 
     def runner(r):

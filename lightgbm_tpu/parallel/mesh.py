@@ -113,12 +113,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
             np.concatenate([np.ones(n, np.float32),
                             np.zeros(pad, np.float32)]).astype(self.dtype),
             self._row_sharding)
+        from ..ops.grow import default_row_capacities
+        local_rows = (n + pad) // n_shards
+        caps = (default_row_capacities(local_rows)
+                if self.row_capacities else ())   # same gate, per-shard rows
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
-                            hist_mode="scatter", hist_dtype=self.dtype,
+                            hist_mode=self.hist_mode, hist_dtype=self.dtype,
                             psum_axis=DATA_AXIS,
                             bundle=self.bundle_arrays,
                             group_bins=self.group_bins,
+                            row_capacities=caps,
+                            cache_hists=self.cache_hists,
                             **self._grow_kwargs(n_shards))
         sharded_grow = _shard_map_compat(
             grow, mesh=self.mesh,
@@ -218,8 +224,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
                  jnp.zeros(fpad, bool)]))
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
-                            hist_mode="scatter", hist_dtype=self.dtype,
-                            feature_axis=FEATURE_AXIS)
+                            hist_mode=self.hist_mode, hist_dtype=self.dtype,
+                            feature_axis=FEATURE_AXIS,
+                            row_capacities=self.row_capacities,
+                            cache_hists=self.cache_hists)
         from ..ops.grow import TreeArrays
         tree_specs = jax.tree_util.tree_map(
             lambda _: P(), TreeArrays(*([0] * len(TreeArrays._fields))))

@@ -1,0 +1,76 @@
+"""Device lambdarank vs the numpy oracle (VERDICT r1 weak #4).
+
+The jitted padded-vmap gradient program must reproduce the reference-shaped
+per-query numpy implementation (rank_objective.hpp:100-190 semantics)
+bit-closely; and ranking training must stay on device end-to-end.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.objectives import LambdarankNDCG
+from lightgbm_tpu.utils.config import Config
+
+
+def _make_ranking(nq=50, seed=3, max_docs=40):
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(1, max_docs, size=nq)
+    n = int(counts.sum())
+    qb = np.concatenate([[0], np.cumsum(counts)])
+    labels = rng.integers(0, 5, size=n).astype(np.float64)
+    X = rng.normal(size=(n, 8))
+    X[:, 0] += labels  # informative feature
+    return X, labels, qb, counts
+
+
+def _objective(labels, qb, weights=None, **params):
+    cfg = Config(dict({"objective": "lambdarank", "verbose": -1}, **params))
+    md = Metadata(len(labels))
+    md.set_label(labels)
+    md.set_query_counts(np.diff(qb))
+    if weights is not None:
+        md.set_weights(weights)
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, len(labels))
+    return obj
+
+
+@pytest.mark.parametrize("with_weights", [False, True])
+def test_device_matches_host_oracle(with_weights):
+    X, labels, qb, counts = _make_ranking()
+    n = len(labels)
+    rng = np.random.default_rng(7)
+    w = rng.random(n) + 0.5 if with_weights else None
+    obj = _objective(labels, qb, weights=w)
+    for it in range(3):
+        score = rng.normal(size=n) * (it + 1)
+        g_d, h_d = obj.get_gradients(score)
+        g_h, h_h = obj.get_gradients_host(score)
+        np.testing.assert_allclose(np.asarray(g_d), np.asarray(g_h),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(h_d), np.asarray(h_h),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_device_handles_degenerate_queries():
+    # single-doc queries and all-equal labels must produce zero lambdas
+    labels = np.array([1.0, 2.0, 2.0, 2.0, 0.0])
+    qb = np.array([0, 1, 4, 5])
+    obj = _objective(labels, qb)
+    g, h = obj.get_gradients(np.array([0.3, 0.1, 0.2, -0.5, 0.9]))
+    assert np.allclose(np.asarray(g), 0.0)
+    assert np.allclose(np.asarray(h), 0.0)
+
+
+def test_ranking_trains_end_to_end():
+    X, labels, qb, counts = _make_ranking(nq=80)
+    ds = lgb.Dataset(X, label=labels, group=np.diff(qb))
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "ndcg_eval_at": [5], "num_leaves": 15,
+                     "learning_rate": 0.1, "verbose": -1},
+                    ds, num_boost_round=20,
+                    valid_sets=[ds], valid_names=["train"])
+    res = bst.eval_train()
+    ndcg = [v for (_, name, v, _) in res if "ndcg" in name][0]
+    assert ndcg > 0.75, ndcg
