@@ -5,12 +5,16 @@ serial_tree_learner.cpp:168-223), which is fine at C++ latencies but fatal
 when the accelerator sits behind a link with ~100ms round-trips.  Here the
 entire grow loop is a `lax.while_loop` inside one jitted program:
 
-  carry: (step, done, leaf_id, per-leaf histogram cache, per-leaf packed
-          best splits, per-leaf sums/depths, flat tree arrays)
+  carry: (step, done, leaf_id, leaf-ordered row permutation + segment
+          table (order/lstart/lcount, used by the ordered schedule), per-leaf
+          histogram cache (absent when histogram_pool_size disables it),
+          per-leaf packed best splits, per-leaf sums, flat tree arrays)
   body:  pick best leaf (argmax over packed gains) -> apply split to the
-         row->leaf map -> smaller child histogram by masked scan, larger by
-         parent-subtraction (feature_histogram.hpp:63-69) -> best-split scan
-         for both children.
+         row->leaf map (masked full-N update, or an in-segment partition of
+         the permutation once the ordered schedule engages) -> smaller child
+         histogram by masked scan or segment gather, larger by
+         parent-subtraction (feature_histogram.hpp:63-69) when the cache is
+         on, else rescanned -> best-split scan for both children.
 
 Tree arrays come back as a device pytree; the host materializes a
 models.Tree from them once per tree (real-valued thresholds resolved on host
@@ -94,7 +98,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  psum_axis: str = None, feature_axis: str = None,
                  voting_k: int = 0, num_voting_machines: int = 1,
                  bundle: BundleArrays = None, group_bins: int = 0,
-                 row_capacities: tuple = (), cache_hists: bool = True):
+                 row_capacities: tuple = (), cache_hists: bool = True,
+                 seg_after: int = 15):
     """Bind `meta`/`bundle` onto the shared memoized grow program.
 
     The heavy lifting lives in `make_grow_core`, which is cached on the
@@ -107,7 +112,7 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           hist_mode, hist_dtype, psum_axis, feature_axis,
                           voting_k, num_voting_machines,
                           bundle is not None, group_bins,
-                          row_capacities, cache_hists)
+                          row_capacities, cache_hists, seg_after)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -130,7 +135,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                    psum_axis: str = None, feature_axis: str = None,
                    voting_k: int = 0, num_voting_machines: int = 1,
                    has_bundle: bool = False, group_bins: int = 0,
-                   row_capacities: tuple = (), cache_hists: bool = True):
+                   row_capacities: tuple = (), cache_hists: bool = True,
+                   seg_after: int = 15):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -160,9 +166,69 @@ def make_grow_core(num_leaves: int, num_bins: int,
     # Pallas kernels take the full-N mask form; gathering only applies to
     # the onehot/scatter kernels.
     use_gather = len(row_capacities) > 0 and hist_mode != "pallas"
+    # Ordered-partition mode: the carry holds a leaf-grouped row permutation
+    # (DataPartition's indices_/leaf_begin_/leaf_count_, data_partition.hpp:
+    # 94-147).  Each split touches ONLY the parent's segment — partition is
+    # O(rows_in_parent) and the smaller-child histogram O(rows_in_child * F)
+    # like the reference's ordered iteration (serial_tree_learner.cpp:424-450,
+    # dense_bin.hpp:66-98) — instead of O(N) per split.  Static shapes via
+    # the capacity-tier ladder.
+    #
+    # TPU economics force a two-phase schedule: random scatter/gather runs
+    # ~125M elem/s on v5e while the masked one-hot pass streams all N rows
+    # in ~2.4ms/1M — so for the first SEG_AFTER splits (big leaves) the
+    # masked full-N path is cheaper, and ONE stable sort of leaf_id at the
+    # transition builds the permutation that every later (small) split
+    # partitions in-segment.  The sort amortizes over the L-1-SEG_AFTER
+    # deep splits that dominate a 255-leaf tree.
+    #
+    # Disabled under the feature-parallel learner (its go-left bitmask psum
+    # would sit inside a tier switch, which collectives cannot: branches
+    # must agree across shards); FP keeps the compact-per-split gather.
+    SEG_AFTER = seg_after
+    # measured on v5e (1M x 28 x 63 bins): segment splits cost ~1.5-1.8ms in
+    # gather/scatter versus ~2.3ms for a full masked pass, so the ordered
+    # schedule only wins when deep cheap splits dominate (large trees);
+    # below the crossover the pure masked streaming path is faster
+    ordered = (use_gather and feature_axis is None
+               and num_leaves - 1 > 128)
     # TPU: sort-based compaction (scatter ~8ms + cumsum ~2.4ms vs top_k
     # ~3.4ms at 1M rows, measured); CPU: cumsum+scatter is cheaper.
     compact_mode = "topk" if jax.default_backend() == "tpu" else "scatter"
+
+    def seg_tier(count):
+        """Index of the smallest capacity tier holding `count` rows."""
+        capv = jnp.asarray(row_capacities, jnp.int32)      # descending
+        return jnp.clip(jnp.sum((capv >= count).astype(jnp.int32)) - 1, 0,
+                        len(row_capacities) - 1)
+
+    def seg_block(order, start, count, cap: int):
+        """A (cap,) window of `order` covering segment [start, start+count).
+
+        The slice start is clamped so the window stays in bounds without
+        padding; `valid` marks the segment's positions inside the window.
+        off + count <= cap always holds because start + count <= n.
+        """
+        n = order.shape[0]
+        s = jnp.clip(start, 0, max(n - cap, 0))
+        off = start - s
+        blk = lax.dynamic_slice(order, (s,), (cap,))
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        valid = (pos >= off) & (pos < off + count)
+        return s, off, blk, valid
+
+    def seg_hist(X, g, h, row_mult, order, start, count):
+        """(F, B, 3) histogram of the rows in segment [start, start+count)
+        of `order` — this shard's part, no collectives (tier switches may
+        diverge across shards; callers psum outside)."""
+        def branch(cap):
+            def run(_):
+                _, _, blk, valid = seg_block(order, start, count, cap)
+                return gathered_histogram(X, g, h, row_mult, blk, valid,
+                                          hist_bins, hist_mode)
+            return run
+        return lax.switch(seg_tier(count),
+                          [branch(c) for c in row_capacities], None)
 
     if hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot, num_bins=hist_bins)
@@ -194,19 +260,26 @@ def make_grow_core(num_leaves: int, num_bins: int,
             return lax.psum(x, psum_axis)
         return x
 
+    # compact-per-split gathers only pay where the masked pass is repeated
+    # per shard over replicated rows (the feature-parallel learner, which
+    # cannot run ordered mode); serial/data-parallel non-ordered growth
+    # keeps the cheaper masked streaming pass (measured: top_k compaction
+    # ~3.4ms vs masked one-hot ~2.4ms at 1M x 28 x 63 on v5e)
+    compact_gather = use_gather and not ordered and feature_axis is not None
+
     def local_hist(X, g, h, leaf_id, leaf, row_mult):
-        """This shard's histogram of `leaf` — gathered when capacities are
-        configured (O(rows_in_leaf) like dense_bin.hpp:66-98), else the
-        legacy full-N masked scan."""
-        if not use_gather:
+        """This shard's histogram of `leaf` — compact-gathered under the
+        feature-parallel learner (O(rows_in_leaf) like dense_bin.hpp:66-98),
+        else the full-N masked scan.  Ordered mode handles small leaves via
+        segments, so its remaining callers (root + big-leaf phase) always
+        take the masked streaming pass."""
+        if not compact_gather:
             return hist_fn(X, g, h, leaf_id, leaf, row_mult)
         mask = leaf_id == leaf
         count = jnp.sum(mask.astype(jnp.int32))
         if compact_mode == "scatter":
             pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        caps = jnp.asarray(row_capacities, jnp.int32)    # descending
-        tier = jnp.clip(jnp.sum(caps >= count) - 1, 0,
-                        len(row_capacities) - 1)
+        tier = seg_tier(count)
 
         def tier_branch(c):
             def run(_):
@@ -304,13 +377,22 @@ def make_grow_core(num_leaves: int, num_bins: int,
         hess = hess.astype(hist_dtype)
         row_mult = row_mult.astype(hist_dtype)
         leaf_id = jnp.zeros(n, dtype=jnp.int32)
+        # ordered mode: leaf-grouped row permutation + per-leaf segment
+        # table (DataPartition's indices_/leaf_begin_/leaf_count_)
+        order = jnp.arange(n, dtype=jnp.int32)
+        lstart = jnp.zeros(L, dtype=jnp.int32)
+        lcount = jnp.zeros(L, dtype=jnp.int32).at[0].set(n)
         if psum_axis is not None:
             # under shard_map the row->leaf map is shard-varying from the
             # first split on; mark the initial carry accordingly (VMA rules)
-            try:
-                leaf_id = lax.pcast(leaf_id, (psum_axis,), to="varying")
-            except (AttributeError, TypeError):
-                leaf_id = lax.pvary(leaf_id, (psum_axis,))
+            def _pvary(x):
+                try:
+                    return lax.pcast(x, (psum_axis,), to="varying")
+                except (AttributeError, TypeError):
+                    return lax.pvary(x, (psum_axis,))
+            leaf_id = _pvary(leaf_id)
+            order, lstart, lcount = (_pvary(order), _pvary(lstart),
+                                     _pvary(lcount))
 
         if feature_axis is not None:
             F_local = X.shape[1]
@@ -375,7 +457,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
             return (step < L - 1) & ~done
 
         def body(carry):
-            step, done, leaf_id, hists, bests, sums, tree = carry
+            (step, done, leaf_id, order, lstart, lcount, hists, bests, sums,
+             tree) = carry
             gains = bests[:, GAIN]
             best_leaf = jnp.argmax(gains).astype(jnp.int32)
             info = bests[best_leaf]
@@ -390,34 +473,126 @@ def make_grow_core(num_leaves: int, num_bins: int,
             fdefault = meta.default_bin[f]
             default_left = jnp.where(cat, dbz == thr, dbz <= thr)
 
-            # ---- partition (dense_bin.hpp:190-222 semantics)
-            if feature_axis is not None:
-                # the winning column lives on exactly one feature shard;
-                # compute its go-left mask there and psum it to everyone —
-                # the "every rank re-executes the split" step of the
-                # reference collapses to one bitmask broadcast
-                own = (f >= offset) & (f < offset + F_local)
-                fl = jnp.clip(f - offset, 0, F_local - 1)
-                col = jnp.take(X, fl, axis=1).astype(jnp.int32)
-            elif has_bundle:
+            def bundle_remap(gcol):
                 # group column -> feature-local bins (feature_group.h
                 # PushData inverted); out-of-range rows sit at the default
-                gcol = jnp.take(X, bundle.group_of[f], axis=1).astype(
-                    jnp.int32)
-                off = bundle.bin_off[f]
-                in_range = (gcol >= off) & (gcol < off + bundle.bin_span[f])
-                col = jnp.where(in_range, gcol - off + bundle.bin_adj[f],
-                                fdefault)
+                goff = bundle.bin_off[f]
+                in_range = (gcol >= goff) & (gcol < goff + bundle.bin_span[f])
+                return jnp.where(in_range, gcol - goff + bundle.bin_adj[f],
+                                 fdefault)
+
+            def split_column_full():
+                """Winning feature's bin values for ALL rows (this shard)."""
+                j = bundle.group_of[f] if has_bundle else f
+                col = jnp.take(X, j, axis=1).astype(jnp.int32)
+                return bundle_remap(col) if has_bundle else col
+
+            def go_left_of(col):
+                """dense_bin.hpp:190-222: threshold compare with the default
+                bin routed by default_left."""
+                gl = jnp.where(cat, col == thr, col <= thr)
+                return jnp.where(col == fdefault, default_left, gl)
+
+            # ---- partition (dense_bin.hpp:190-222 semantics)
+            if ordered:
+                # transition: ONE stable sort of leaf_id builds the
+                # leaf-grouped permutation + segment table that all later
+                # (small) splits partition in-segment
+                def do_sort(_):
+                    o = jnp.argsort(leaf_id).astype(jnp.int32)
+                    slid = jnp.take(leaf_id, o)
+                    lid_iota = jnp.arange(L, dtype=jnp.int32)
+                    ls = jnp.searchsorted(slid, lid_iota,
+                                          side="left").astype(jnp.int32)
+                    le = jnp.searchsorted(slid, lid_iota,
+                                          side="right").astype(jnp.int32)
+                    return o, ls, le - ls
+
+                order, lstart, lcount = lax.cond(
+                    step == SEG_AFTER, do_sort,
+                    lambda _: (order, lstart, lcount), None)
+
+                def phase_masked(_):
+                    # big-leaf phase: full-N masked update (VPU streaming
+                    # beats scatter at these row counts)
+                    in_leaf = leaf_id == best_leaf
+                    go_left = go_left_of(split_column_full())
+                    new_lid = jnp.where(in_leaf & ~go_left, new_leaf,
+                                        leaf_id)
+                    return (jnp.where(ok, new_lid, leaf_id), order, lstart,
+                            lcount)
+
+                def phase_seg(_):
+                    # small-leaf phase: split ONLY the parent's segment
+                    # (DataPartition::Split, data_partition.hpp:118-147) —
+                    # stable in-segment partition + leaf_id scatter for the
+                    # rows that moved right
+                    s_p = lstart[best_leaf]
+                    c_p = lcount[best_leaf]
+
+                    def part_branch(cap):
+                        def run(_):
+                            s, off, blk, valid = seg_block(order, s_p, c_p,
+                                                           cap)
+                            j = bundle.group_of[f] if has_bundle else f
+                            # two gather orders, chosen statically per tier:
+                            # rows-then-column touches cap*F bytes, column-
+                            # then-rows touches n
+                            if cap * X.shape[1] <= n:
+                                colb = jnp.take(jnp.take(X, blk, axis=0), j,
+                                                axis=1).astype(jnp.int32)
+                            else:
+                                colb = jnp.take(jnp.take(X, j, axis=1),
+                                                blk).astype(jnp.int32)
+                            if has_bundle:
+                                colb = bundle_remap(colb)
+                            gl = go_left_of(colb) & valid
+                            nleft = jnp.sum(gl.astype(jnp.int32))
+                            posl = jnp.cumsum(gl.astype(jnp.int32)) - 1
+                            posr = (nleft - 1
+                                    + jnp.cumsum(
+                                        (valid & ~gl).astype(jnp.int32)))
+                            tgt = jnp.where(gl, posl, posr) + off
+                            tgt = jnp.where(valid & ok, tgt, cap)  # ~ok: noop
+                            new_blk = blk.at[tgt].set(blk, mode="drop")
+                            new_order = lax.dynamic_update_slice(
+                                order, new_blk, (s,))
+                            ridx = jnp.where(valid & ~gl & ok, blk, n)
+                            new_lid = leaf_id.at[ridx].set(new_leaf,
+                                                           mode="drop")
+                            return new_order, new_lid, nleft
+                        return run
+
+                    new_order, new_lid, nleft = lax.switch(
+                        seg_tier(c_p),
+                        [part_branch(c) for c in row_capacities], None)
+                    ls = lstart.at[new_leaf].set(
+                        jnp.where(ok, s_p + nleft, lstart[new_leaf]))
+                    lc = lcount.at[new_leaf].set(
+                        jnp.where(ok, c_p - nleft, lcount[new_leaf]))
+                    lc = lc.at[best_leaf].set(
+                        jnp.where(ok, nleft, lc[best_leaf]))
+                    return new_lid, new_order, ls, lc
+
+                leaf_id, order, lstart, lcount = lax.cond(
+                    step < SEG_AFTER, phase_masked, phase_seg, None)
             else:
-                col = jnp.take(X, f, axis=1).astype(jnp.int32)
-            in_leaf = leaf_id == best_leaf
-            go_left = jnp.where(cat, col == thr, col <= thr)
-            go_left = jnp.where(col == fdefault, default_left, go_left)
-            if feature_axis is not None:
-                go_left = lax.psum((go_left & own).astype(jnp.int32),
-                                   feature_axis) > 0
-            new_leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, leaf_id)
-            leaf_id = jnp.where(ok, new_leaf_id, leaf_id)
+                if feature_axis is not None:
+                    # the winning column lives on exactly one feature shard;
+                    # compute its go-left mask there and psum it to everyone —
+                    # the "every rank re-executes the split" step of the
+                    # reference collapses to one bitmask broadcast
+                    own = (f >= offset) & (f < offset + F_local)
+                    fl = jnp.clip(f - offset, 0, F_local - 1)
+                    col = jnp.take(X, fl, axis=1).astype(jnp.int32)
+                    go_left = lax.psum(
+                        (go_left_of(col) & own).astype(jnp.int32),
+                        feature_axis) > 0
+                else:
+                    go_left = go_left_of(split_column_full())
+                in_leaf = leaf_id == best_leaf
+                new_leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, leaf_id)
+                leaf_id = jnp.where(ok, new_leaf_id, leaf_id)
 
             # ---- tree bookkeeping (tree.cpp:55-110)
             parent = tree.leaf_parent[best_leaf]
@@ -475,7 +650,25 @@ def make_grow_core(num_leaves: int, num_bins: int,
             small_sums = jnp.where(left_smaller, left_sums, right_sums)
             large_sums = jnp.where(left_smaller, right_sums, left_sums)
 
-            hist_small = hist_of_leaf(X, grad, hess, leaf_id, small, row_mult)
+            if ordered:
+                def hist_of_seg(leaf):
+                    # phase-matched local histogram; the psum sits OUTSIDE
+                    # both the phase cond and the tier switch (tier choice
+                    # is shard-varying under the data mesh)
+                    hl = lax.cond(
+                        step < SEG_AFTER,
+                        lambda lf: hist_fn(X, grad, hess, leaf_id, lf,
+                                           row_mult),
+                        lambda lf: seg_hist(X, grad, hess, row_mult, order,
+                                            lstart[lf], lcount[lf]),
+                        leaf)
+                    if voting:
+                        return hl
+                    return maybe_psum(hl)
+                hist_small = hist_of_seg(small)
+            else:
+                hist_small = hist_of_leaf(X, grad, hess, leaf_id, small,
+                                          row_mult)
             if cache_hists:
                 # larger child by parent subtraction (feature_histogram.hpp:63)
                 hist_large = hists[best_leaf] - hist_small
@@ -483,6 +676,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                     jnp.where(ok, hist_small, hists[small]))
                 hists = hists.at[large].set(
                     jnp.where(ok, hist_large, hists[large]))
+            elif ordered:
+                hist_large = hist_of_seg(large)
             else:
                 hist_large = hist_of_leaf(X, grad, hess, leaf_id, large,
                                           row_mult)
@@ -496,13 +691,13 @@ def make_grow_core(num_leaves: int, num_bins: int,
             bests = bests.at[small].set(jnp.where(ok, best_small, bests[small]))
             bests = bests.at[large].set(jnp.where(ok, best_large, bests[large]))
 
-            return (step + ok.astype(jnp.int32), ~ok, leaf_id, hists, bests,
-                    sums, tree)
+            return (step + ok.astype(jnp.int32), ~ok, leaf_id, order, lstart,
+                    lcount, hists, bests, sums, tree)
 
         carry = (jnp.asarray(0, jnp.int32), jnp.asarray(False), leaf_id,
-                 hists, bests, sums, tree)
+                 order, lstart, lcount, hists, bests, sums, tree)
         carry = lax.while_loop(cond, body, carry)
-        _, _, leaf_id, _, _, _, tree = carry
+        leaf_id, tree = carry[2], carry[-1]
         return tree, leaf_id
 
     return grow

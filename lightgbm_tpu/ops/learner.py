@@ -140,16 +140,14 @@ class SerialTreeLearner:
         self.cache_hists = hist_cache_enabled(
             config, self.num_leaves, ncols, nbins,
             8 if config.tpu_use_dp else 4)
-        # Gather-compacted leaf histograms (O(rows_in_leaf), capacity tiers)
-        # pay off when the per-row histogram work dwarfs the fixed O(N)
-        # compaction cost: always on CPU (compaction is cheap there); on
-        # TPU only for wide histograms — at F*B ~ 1764 (Higgs 28x63) the
-        # masked one-hot pass (~2.4ms at 1M rows) is CHEAPER than one
-        # top_k compaction (~3.4ms), so small shapes keep the masked scan.
-        gather_pays = (jax.default_backend() != "tpu"
-                       or ncols * nbins >= 4096)
+        # Ordered-partition growth (grow.py): per-split cost is O(parent
+        # segment) for the partition and O(child segment * F) for the
+        # histogram — the reference's DataPartition + ordered-iteration
+        # economics (data_partition.hpp:94-147, dense_bin.hpp:66-98) — so
+        # the capacity-tier ladder pays at every shape.  Pallas histogram
+        # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
-                               if gather_pays else ())
+                               if hist_mode != "pallas" else ())
         if psum_axis is None:
             # cached jitted core: a second booster/fold with the same
             # static config reuses the compiled executable (meta/bundle

@@ -1,0 +1,93 @@
+"""Ordered-partition (segment) growth == masked growth, bit for bit.
+
+The ordered schedule (ops/grow.py: SEG_AFTER masked splits, one stable sort,
+then in-segment partitions + gathered segment histograms) only engages for
+num_leaves - 1 > 128, which no other test reaches.  This pins it against the
+legacy masked path on identical inputs: same tree arrays, same row->leaf map.
+Reference semantics under test: DataPartition::Split (data_partition.hpp:
+118-147) + ordered histogram iteration (serial_tree_learner.cpp:424-450).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.ops.grow import default_row_capacities, make_grow_fn
+from lightgbm_tpu.ops.learner import build_split_params
+from lightgbm_tpu.ops.split_finder import FeatureMeta
+from lightgbm_tpu.utils.config import Config
+
+N, F, LEAVES = 4096, 10, 255
+
+
+def _setup(categorical=False):
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(N, F))
+    if categorical:
+        X[:, 0] = rng.integers(0, 12, size=N)
+    y = (X[:, 1] + np.sin(X[:, 2] * 3) + 0.3 * rng.normal(size=N) > 0)
+    cfg = Config({"num_leaves": LEAVES, "min_data_in_leaf": 1,
+                  "max_bin": 63, "verbose": -1,
+                  "categorical_feature": "0" if categorical else ""})
+    td = TrainingData.from_matrix(X, label=y.astype(np.float64), config=cfg)
+    meta = FeatureMeta(num_bin=jnp.asarray(td.num_bin_arr),
+                       default_bin=jnp.asarray(td.default_bin_arr),
+                       is_categorical=jnp.asarray(td.is_categorical_arr))
+    p = 0.5
+    grad = jnp.asarray((p - y).astype(np.float32))
+    hess = jnp.full(N, p * (1 - p), jnp.float32)
+    return cfg, td, meta, grad, hess
+
+
+@pytest.mark.parametrize("categorical", [False, True])
+def test_ordered_matches_masked(categorical):
+    cfg, td, meta, grad, hess = _setup(categorical)
+    params = build_split_params(cfg)
+    nb = int(td.num_bin_arr.max())
+    common = dict(hist_mode="scatter", max_depth=-1)
+    kw_masked = dict(common, row_capacities=())
+    kw_seg = dict(common, row_capacities=default_row_capacities(N))
+    ones = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(td.num_features, dtype=bool)
+    args = (jnp.asarray(td.binned), grad, hess, ones, fmask)
+
+    tree_m, lid_m = jax.jit(make_grow_fn(LEAVES, nb, meta, params,
+                                         **kw_masked))(*args)
+    tree_s, lid_s = jax.jit(make_grow_fn(LEAVES, nb, meta, params,
+                                         **kw_seg))(*args)
+
+    nl = int(tree_m.num_leaves)
+    assert nl > 140, "tree too shallow to exercise the segment phase"
+    assert int(tree_s.num_leaves) == nl
+    np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                  np.asarray(tree_m.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_s.threshold_bin),
+                                  np.asarray(tree_m.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(tree_s.left_child),
+                                  np.asarray(tree_m.left_child))
+    np.testing.assert_array_equal(np.asarray(tree_s.leaf_count),
+                                  np.asarray(tree_m.leaf_count))
+    np.testing.assert_allclose(np.asarray(tree_s.leaf_value),
+                               np.asarray(tree_m.leaf_value), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lid_s), np.asarray(lid_m))
+
+
+def test_ordered_matches_masked_with_bagging():
+    cfg, td, meta, grad, hess = _setup()
+    params = build_split_params(cfg)
+    nb = int(td.num_bin_arr.max())
+    rng = np.random.default_rng(3)
+    mult = jnp.asarray((rng.random(N) > 0.3).astype(np.float32))
+    fmask = jnp.ones(td.num_features, dtype=bool)
+    args = (jnp.asarray(td.binned), grad, hess, mult, fmask)
+    tree_m, lid_m = jax.jit(make_grow_fn(
+        LEAVES, nb, meta, params, hist_mode="scatter", max_depth=-1,
+        row_capacities=()))(*args)
+    tree_s, lid_s = jax.jit(make_grow_fn(
+        LEAVES, nb, meta, params, hist_mode="scatter", max_depth=-1,
+        row_capacities=default_row_capacities(N)))(*args)
+    assert int(tree_s.num_leaves) == int(tree_m.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                  np.asarray(tree_m.split_feature))
+    np.testing.assert_array_equal(np.asarray(lid_s), np.asarray(lid_m))
