@@ -75,6 +75,29 @@ class TreeArrays(NamedTuple):
     leaf_depth: jnp.ndarray          # (L,) i32
 
 
+def feature_hist_view(ghist, sums, meta, bundle, has_bundle: bool):
+    """Group histograms -> per-feature (F, B, 3) views with the default
+    bin rebuilt by subtraction (FixHistogram, dataset.cpp:764-783).
+    Shared by the exact (grow) and wave growth engines."""
+    if not has_bundle:
+        return ghist
+    flat = ghist.reshape(-1, 3)
+    v = flat[bundle.gather_idx] * bundle.valid_mask[..., None].astype(
+        ghist.dtype)
+    fidx = jnp.arange(v.shape[0])
+    v = v.at[fidx, meta.default_bin].set(sums[None, :] - v.sum(axis=1))
+    return v
+
+
+def pvary_for(x, axis: str):
+    """Mark x shard-varying over `axis` under shard_map (VMA rules),
+    across jax versions (pcast is the newer spelling of pvary)."""
+    try:
+        return lax.pcast(x, (axis,), to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(x, (axis,))
+
+
 def default_row_capacities(n: int, min_capacity: int = 2048,
                            max_tiers: int = 10):
     """Descending static row-gather capacities n, n/2, n/4, ... — the tier
@@ -244,16 +267,7 @@ def make_grow_core(num_leaves: int, num_bins: int,
                   "(expected auto/scatter/onehot/pallas)", hist_mode)
 
     def to_feature_hist(ghist, sums, meta, bundle):
-        """Group histograms -> per-feature (F, B, 3) views with the default
-        bin rebuilt by subtraction (FixHistogram, dataset.cpp:764-783)."""
-        if not has_bundle:
-            return ghist
-        flat = ghist.reshape(-1, 3)
-        v = flat[bundle.gather_idx] * bundle.valid_mask[..., None].astype(
-            ghist.dtype)
-        fidx = jnp.arange(v.shape[0])
-        v = v.at[fidx, meta.default_bin].set(sums[None, :] - v.sum(axis=1))
-        return v
+        return feature_hist_view(ghist, sums, meta, bundle, has_bundle)
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -378,21 +392,24 @@ def make_grow_core(num_leaves: int, num_bins: int,
         row_mult = row_mult.astype(hist_dtype)
         leaf_id = jnp.zeros(n, dtype=jnp.int32)
         # ordered mode: leaf-grouped row permutation + per-leaf segment
-        # table (DataPartition's indices_/leaf_begin_/leaf_count_)
-        order = jnp.arange(n, dtype=jnp.int32)
-        lstart = jnp.zeros(L, dtype=jnp.int32)
-        lcount = jnp.zeros(L, dtype=jnp.int32).at[0].set(n)
+        # table (DataPartition's indices_/leaf_begin_/leaf_count_);
+        # size-0 placeholders otherwise so non-ordered growers don't carry
+        # dead O(N) loop state
+        if ordered:
+            order = jnp.arange(n, dtype=jnp.int32)
+            lstart = jnp.zeros(L, dtype=jnp.int32)
+            lcount = jnp.zeros(L, dtype=jnp.int32).at[0].set(n)
+        else:
+            order = jnp.zeros(0, jnp.int32)
+            lstart = jnp.zeros(0, jnp.int32)
+            lcount = jnp.zeros(0, jnp.int32)
         if psum_axis is not None:
             # under shard_map the row->leaf map is shard-varying from the
             # first split on; mark the initial carry accordingly (VMA rules)
-            def _pvary(x):
-                try:
-                    return lax.pcast(x, (psum_axis,), to="varying")
-                except (AttributeError, TypeError):
-                    return lax.pvary(x, (psum_axis,))
-            leaf_id = _pvary(leaf_id)
-            order, lstart, lcount = (_pvary(order), _pvary(lstart),
-                                     _pvary(lcount))
+            leaf_id = pvary_for(leaf_id, psum_axis)
+            order = pvary_for(order, psum_axis)
+            lstart = pvary_for(lstart, psum_axis)
+            lcount = pvary_for(lcount, psum_axis)
 
         if feature_axis is not None:
             F_local = X.shape[1]

@@ -148,7 +148,36 @@ class SerialTreeLearner:
         # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
                                if hist_mode != "pallas" else ())
-        if psum_axis is None:
+        # growth schedule: 'wave' batches the top-W pending splits per
+        # sweep so the histogram work rides the MXU (ops/wave.py); 'exact'
+        # is the per-split leaf-wise order of the reference (ops/grow.py).
+        # auto -> wave on TPU.  NOTE: the default tpu_wave_width (16) is an
+        # approximation of the leaf-wise ORDER (same greedy frontier,
+        # batched; quality parity shown in tests/test_wave.py) — set
+        # tpu_wave_width=1 for the reference's exact split sequence.
+        growth = config.tpu_growth
+        if growth == "auto":
+            growth = ("wave" if jax.default_backend() == "tpu"
+                      and hist_mode != "pallas" else "exact")
+        self.growth = growth
+        self.wave_width = int(config.tpu_wave_width)
+        # distributed learners (psum_axis set) own their grow construction
+        # in parallel/mesh.py — including the wave-vs-voting choice
+        if growth == "wave" and psum_axis is None:
+            from .wave import make_wave_jit
+            core = make_wave_jit(
+                self.num_leaves, self.num_bins, self.params,
+                config.max_depth, self.wave_width, self.dtype, None,
+                self.bundle_arrays is not None, self.group_bins,
+                self.cache_hists, hist_mode, 16384)
+            meta, bund = self.meta, self.bundle_arrays
+
+            def _grow(X, g, h, rm, m, _core=core, _meta=meta,
+                      _bund=bund):
+                return _core(X, g, h, rm, m, _meta, _bund)
+
+            self._grow = _grow
+        elif psum_axis is None:
             # cached jitted core: a second booster/fold with the same
             # static config reuses the compiled executable (meta/bundle
             # are call-time args, ops/grow.py make_grow_jit)

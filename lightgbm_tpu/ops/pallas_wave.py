@@ -1,0 +1,164 @@
+"""Fused wave-histogram Pallas kernel — the hot op of wave growth.
+
+The XLA wave pass (ops/wave.py) materializes the (chunk, F*B) bin one-hot
+to HBM between the VPU construction and the MXU contraction; at Higgs scale
+that is ~74 GB of pure one-hot traffic per boosting iteration and sets the
+whole training rate (measured: ~90ms/wave of a ~106ms wave at 10.5M rows).
+
+This kernel generates the one-hot INSIDE VMEM, tile by tile, builds the
+per-child masked weights in VMEM too, and feeds the MXU directly:
+
+    for each row tile (Cg rows):
+        oh    = (repeat(X_tile, Bp) == lane_bin_iota)        # VPU, in VMEM
+        match = (leaf_tile == child_ids)                      # (Cg, K)
+        w     = [match*g | match*h | match*mult]              # (Cg, 3K)
+        acc  += ohᵀ @ bf16_hi(w) + ohᵀ @ bf16_lo(w)          # MXU
+
+HBM traffic per wave drops to reading X (N*F bytes) + leaf_id + w3 —
+~100x less than the materialized one-hot.  Precision: the one-hot is exact
+in bf16 (it holds only 0/1); the weights are split into bf16 high + bf16
+residual parts whose products accumulate in f32, giving ~2^-17 relative
+error versus the reference's single-precision GPU histograms
+(src/treelearner/ocl/histogram*.cl accumulate float).
+
+Layout notes: `pltpu.repeat` TILES its operand ([x_0..x_F, x_0..x_F, ...]),
+so the one-hot is bin-major — column j holds (feature j % F, bin j // F) —
+and everything stays 2D (no Mosaic 3D reshapes).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _bin_pad(num_bins: int) -> int:
+    """Padded per-feature bin width so F*Bp stays lane-friendly."""
+    if num_bins <= 64:
+        return 64
+    return ((num_bins + 127) // 128) * 128
+
+
+def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
+                      *, bp, fc, k, bsub):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # bin ids are exact in f32 and the VPU compares f32 natively (bf16
+    # compares are rejected by Mosaic on v5e); only the 0/1 one-hot result
+    # is emitted in bf16 for the MXU
+    x = x_ref[:].astype(jnp.int32).astype(jnp.float32)   # (Cg, Fc)
+    cg = x.shape[0]
+
+    # child match + channel-major weights, built in VMEM — nothing
+    # per-wave crosses HBM beyond X/leaf_id/w3 themselves
+    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
+    w3 = w3_ref[:]                                           # (Cg, 3)
+    wmat = jnp.concatenate(
+        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
+    # exact hi/lo split by mantissa truncation — a bf16 round-trip would be
+    # folded to identity under --xla_allow_excess_precision, silently
+    # zeroing the residual term (observed on v5e)
+    wh_f32 = pltpu.bitcast(
+        pltpu.bitcast(wmat, jnp.uint32) & jnp.uint32(0xFFFF0000),
+        jnp.float32)
+    wh = wh_f32.astype(jnp.bfloat16)                 # exact: mantissa fits
+    # residual, scaled by 2^8 (exact) into bf16 range.  Mosaic's f32->bf16
+    # cast TRUNCATES (measured: biased sums ~100x above round-to-nearest
+    # theory), so round manually in bit arithmetic first — after that the
+    # cast drops only zero bits.
+    wl_f32 = (wmat - wh_f32) * jnp.float32(256.0)
+    wl = pltpu.bitcast(
+        (pltpu.bitcast(wl_f32, jnp.uint32) + jnp.uint32(0x8000))
+        & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
+
+    xr = pltpu.repeat(x, bsub, axis=1)                   # (Cg, bsub*Fc)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
+    base = (lane // fc).astype(jnp.float32)              # 0..bsub-1 pattern
+    for s in range(bp // bsub):
+        # bins [s*bsub, (s+1)*bsub) x all features, bin-major columns.
+        # f32 select then downcast: the i1 result carries f32 (8,128)
+        # tiling and Mosaic cannot relayout it straight into a bf16 select
+        oh = jnp.where(xr == base + jnp.float32(s * bsub),
+                       jnp.float32(1.0),
+                       jnp.float32(0.0)).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            oh, wh, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bsub*Fc, 3K)
+        acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
+            oh, wl, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = slice(s * bsub * fc, (s + 1) * bsub * fc)
+        out_ref[rows, :] = out_ref[rows, :] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
+                                             "interpret"))
+def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
+                          row_tile: int = 8192, interpret: bool = False):
+    """(K, F, B, 3) histograms of the rows whose leaf is child_id[k].
+
+    X: (N, F) uint8/int bin ids;  leaf_id: (N,) int32 (already partitioned);
+    w3: (N, 3) float32 [g, h, mult] per-row channels;
+    child_id: (K,) int32 target leaves, -1 entries yield zero histograms.
+    """
+    n, fc = X.shape
+    k = child_id.shape[0]
+    bp = _bin_pad(num_bins)
+    # bins per inner sub-block: ~512 lanes per one-hot tile, a power of two
+    # so it divides bp (64 or a multiple of 128)
+    bsub = 1
+    while bsub * 2 * fc <= 512 and bsub * 2 <= bp:
+        bsub *= 2
+    # keep the (Cg, bsub*fc) f32/bf16 tiles within ~16MB each so a handful
+    # of live temporaries fit the raised VMEM budget; bigger row tiles
+    # amortize the per-grid-step pipeline overhead
+    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
+    c = min(c, max(n, 1))
+    pad = (-n) % c
+    lid2 = leaf_id[:, None]
+    w3f = w3.astype(jnp.float32)
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
+        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
+    nch = (n + pad) // c
+
+    kernel = functools.partial(_wave_hist_kernel, bp=bp, fc=fc, k=k,
+                               bsub=bsub)
+    flat = pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[
+            pl.BlockSpec((c, fc), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 3), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(X, lid2, w3f, child_id[None, :])
+    # (Bp*Fc, 3K) bin-major rows, channel-major cols -> (K, Fc, B, 3)
+    h = flat.reshape(bp, fc, 3, k)[:num_bins]
+    return jnp.transpose(h, (3, 1, 0, 2))
+
+
+def wave_histogram_reference(X, leaf_id, w3, child_id, num_bins: int):
+    """Pure-XLA oracle for the kernel (same contract, any backend)."""
+    match = (leaf_id[:, None] == child_id[None, :]).astype(jnp.float32)
+    oh = jax.nn.one_hot(X.astype(jnp.int32), num_bins, dtype=jnp.float32)
+    return jnp.einsum("nfb,nk,nc->kfbc", oh, match, w3)

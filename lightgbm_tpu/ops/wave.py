@@ -1,0 +1,439 @@
+"""Wave growth — best-first tree construction batched for the MXU.
+
+The reference grows leaf-wise, one split at a time, histogramming only the
+smaller child's rows (serial_tree_learner.cpp:168-223).  That economics
+relies on cheap random access; on TPU, random gather/scatter runs orders of
+magnitude below the streaming/matmul roofline (measured ~μs/row via XLA
+gather on v5e), so per-leaf row gathers lose to full passes.
+
+The TPU-native schedule instead splits the top-W pending leaves per WAVE:
+
+* ONE streaming partition pass moves every affected row (each row looks up
+  its leaf's chosen split in an (L,K) table via a one-hot contraction — no
+  gathers);
+* ONE batched histogram pass computes ALL W smaller-child histograms:
+  per row chunk, the bin one-hot (C, F*B) is contracted against per-child
+  masked weights (C, 3W) on the MXU.  The one-hot construction (the VPU
+  cost) is paid once per wave instead of once per split, and the
+  contraction rides the MXU at ~25-50x the VPU rate — this is where a
+  255-leaf tree's 254 histogram scans collapse.
+* larger children come from parent subtraction (feature_histogram.hpp:63)
+  against the same per-leaf cache the leaf-wise grower uses, and the packed
+  best-split search (split_finder.py) vmaps over all 2W children.
+
+wave_width=1 reproduces the reference's leaf-wise order EXACTLY (top-1 ==
+argmax, identical node numbering to ops/grow.py).  Larger waves split the
+top-W by gain simultaneously — the same greedy frontier, batched; tree
+quality matches leaf-wise to benchmark noise (see tests/test_wave.py) while
+training time per tree drops from O(num_leaves) full passes to
+O(num_leaves / W) passes plus MXU time.
+
+Under a data mesh the two passes are shard-local and the wave's histogram
+block is psum'd ONCE per wave — W× less collective latency than per-split
+reductions (data_parallel_tree_learner.cpp:148-222 analog).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .grow import TreeArrays, feature_hist_view, pvary_for
+from .histogram import leaf_histogram_onehot, leaf_histogram_scatter
+from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
+                           LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
+                           RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G,
+                           RIGHT_SUM_H, SPLIT_VEC_SIZE, THRESHOLD,
+                           FeatureMeta, SplitParams, find_best_split_impl)
+
+
+def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
+                      params: SplitParams, max_depth: int,
+                      wave_width: int = 16, hist_dtype=jnp.float32,
+                      psum_axis: str = None, bundle=None,
+                      group_bins: int = 0, cache_hists: bool = True,
+                      hist_mode: str = "onehot", chunk: int = 16384):
+    """Bind meta/bundle onto the cached wave-grow program (same contract as
+    ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
+    (TreeArrays, leaf_id))."""
+    core = make_wave_core(num_leaves, num_bins, params, max_depth,
+                          wave_width, hist_dtype, psum_axis,
+                          bundle is not None, group_bins, cache_hists,
+                          hist_mode, chunk)
+
+    def grow(X, grad, hess, row_mult, feature_mask):
+        return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
+
+    grow.core = core
+    return grow
+
+
+@functools.lru_cache(maxsize=64)
+def make_wave_jit(*static_args):
+    """jit(make_wave_core(...)) cached on the static key so repeated
+    boosters / cv folds reuse one compiled executable (the wave analog of
+    grow.make_grow_jit)."""
+    return jax.jit(make_wave_core(*static_args))
+
+
+@functools.lru_cache(maxsize=64)
+def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
+                   max_depth: int, wave_width: int, hist_dtype,
+                   psum_axis: str, has_bundle: bool, group_bins: int,
+                   cache_hists: bool, hist_mode: str, chunk: int):
+    L = num_leaves
+    W = max(1, min(wave_width, L - 1))
+    hist_bins = group_bins if has_bundle else num_bins
+    # the bin one-hot holds only 0/1 — exact in bf16 — and is the dominant
+    # HBM traffic of the wave pass; on TPU the MXU also multiplies bf16
+    # natively.  Weights and the accumulator stay in hist_dtype.
+    oh_dtype = (jnp.bfloat16
+                if jax.default_backend() == "tpu"
+                and hist_dtype == jnp.float32 else hist_dtype)
+    # fused Pallas kernel (ops/pallas_wave.py): generates the one-hot in
+    # VMEM instead of materializing (chunk, F*B) blocks through HBM.
+    # Opt-in (hist_mode='pallas') while its precision work is validated:
+    # Mosaic's f32->bf16 cast truncates, and the resulting histogram bias
+    # measurably costs AUC without the manual-rounding fix.
+    use_pallas_hist = (jax.default_backend() == "tpu"
+                       and hist_dtype == jnp.float32
+                       and hist_mode == "pallas")
+
+    def maybe_psum(x):
+        if psum_axis is not None:
+            return lax.psum(x, psum_axis)
+        return x
+
+    def to_feature_hist(ghist, sums, meta, bundle):
+        return feature_hist_view(ghist, sums, meta, bundle, has_bundle)
+
+    root_hist_fn = (leaf_histogram_onehot if hist_mode == "onehot"
+                    else leaf_histogram_scatter)
+
+    def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
+        n = X.shape[0]
+        Fc = X.shape[1]                   # group columns on device
+        grad = grad.astype(hist_dtype)
+        hess = hess.astype(hist_dtype)
+        row_mult = row_mult.astype(hist_dtype)
+        w3 = jnp.stack([grad * row_mult, hess * row_mult, row_mult],
+                       axis=-1)           # (N, 3) per-row weight channels
+        leaf_id = jnp.zeros(n, dtype=jnp.int32)
+        if psum_axis is not None:
+            leaf_id = pvary_for(leaf_id, psum_axis)
+
+        c = min(chunk, max(n, 1))
+        pad = (-n) % c
+        nch = (n + pad) // c
+        Xp = jnp.pad(X, ((0, pad), (0, 0))) if pad else X
+        xb = Xp.reshape(nch, c, Fc)
+
+        def wave_pass(leaf_id, tbl, small_id, valid):
+            """Partition + child histograms, fused into ONE chunked sweep.
+
+            Per chunk: rows look up their leaf's split row in the (L, 10)
+            table via a one-hot contraction, route left/right (the
+            partition), then the chunk's bin one-hot (C, Fc*B) is contracted
+            against per-child masked weights (C, 3W) on the MXU.  Nothing
+            N x L or N x W is ever materialized.  Shard-local; callers psum
+            the histogram block.
+
+            On TPU the histogram half runs as the fused Pallas kernel
+            (one-hot generated in VMEM, ops/pallas_wave.py) and the scan
+            below only partitions.
+            """
+            lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
+                else leaf_id.reshape(nch, c)
+            wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3
+            wb3 = wpad.reshape(nch, c, 3)
+            l_iota = jnp.arange(L, dtype=jnp.int32)
+            f_iota = jnp.arange(Fc, dtype=jnp.int32)
+
+            def step(acc, args):
+                xc, lc, wc = args                   # (C,Fc) (C,) (C,3)
+                leaf_oh = (lc[:, None] == l_iota[None, :]).astype(
+                    jnp.float32)                    # (C, L)
+                # HIGHEST: TPU's default matmul precision is bf16, which
+                # rounds integer table entries above 256 (column ids,
+                # thresholds, leaf ids) — the lookup must be exact f32
+                r = jnp.matmul(leaf_oh, tbl,
+                               precision=lax.Precision.HIGHEST)  # (C, 10)
+                active = r[:, 0] > 0.5
+                cj = r[:, 1].astype(jnp.int32)
+                colv = jnp.sum(
+                    jnp.where(cj[:, None] == f_iota[None, :], xc, 0)
+                    .astype(jnp.int32), axis=1)     # (C,) split-column bin
+                if has_bundle:
+                    goff = r[:, 7].astype(jnp.int32)
+                    in_range = ((colv >= goff)
+                                & (colv < goff + r[:, 9].astype(jnp.int32)))
+                    colv = jnp.where(
+                        in_range, colv - goff + r[:, 8].astype(jnp.int32),
+                        r[:, 4].astype(jnp.int32))
+                thr_r = r[:, 2].astype(jnp.int32)
+                gl = jnp.where(r[:, 3] > 0.5, colv == thr_r, colv <= thr_r)
+                gl = jnp.where(colv == r[:, 4].astype(jnp.int32),
+                               r[:, 5] > 0.5, gl)
+                lc2 = jnp.where(active & ~gl, r[:, 6].astype(jnp.int32), lc)
+                if not use_pallas_hist:
+                    # child-masked weights: (C, W) match x (C, 3) channels
+                    match = ((lc2[:, None] == small_id[None, :])
+                             & valid[None, :]).astype(hist_dtype)
+                    wmat = (match[:, :, None]
+                            * wc[:, None, :]).reshape(c, 3 * W)
+                    oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
+                                        dtype=oh_dtype)      # (C, Fc, B)
+                    acc = acc + jnp.einsum(
+                        "cq,cw->qw", oh.reshape(c, Fc * hist_bins), wmat,
+                        preferred_element_type=hist_dtype)
+                return acc, lc2
+
+            acc_shape = ((Fc * hist_bins, 3 * W) if not use_pallas_hist
+                         else (1, 1))
+            init = jnp.zeros(acc_shape, dtype=hist_dtype)
+            if nch == 1:
+                flat, lid2 = step(init, (xb[0], lb[0], wb3[0]))
+                new_leaf_id = lid2[:n]
+            else:
+                flat, lid2 = lax.scan(step, init, (xb, lb, wb3))
+                new_leaf_id = lid2.reshape(-1)[:n]
+            if use_pallas_hist:
+                from .pallas_wave import wave_histogram_pallas
+                cid = jnp.where(valid, small_id, -1)
+                hist = wave_histogram_pallas(X, new_leaf_id, w3, cid,
+                                             hist_bins)
+            else:
+                # (Fc*B, W*3) -> (W, Fc, B, 3)
+                hist = flat.reshape(Fc, hist_bins, W, 3).transpose(2, 0, 1,
+                                                                   3)
+            return new_leaf_id, hist
+
+        def rehist(leaf_id, ids, valid):
+            """Histograms of `ids` children only (no partition) — the
+            no-cache larger-child pass."""
+            if use_pallas_hist:
+                from .pallas_wave import wave_histogram_pallas
+                return wave_histogram_pallas(
+                    X, leaf_id, w3, jnp.where(valid, ids, -1), hist_bins)
+            lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
+                else leaf_id.reshape(nch, c)
+            wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3
+            wb3 = wpad.reshape(nch, c, 3)
+
+            def step(acc, args):
+                xc, lc, wc = args
+                match = ((lc[:, None] == ids[None, :])
+                         & valid[None, :]).astype(hist_dtype)
+                wmat = (match[:, :, None] * wc[:, None, :]).reshape(c, 3 * W)
+                oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
+                                    dtype=oh_dtype)
+                acc = acc + jnp.einsum(
+                    "cq,cw->qw", oh.reshape(c, Fc * hist_bins), wmat,
+                    preferred_element_type=hist_dtype)
+                return acc, None
+
+            init = jnp.zeros((Fc * hist_bins, 3 * W), dtype=hist_dtype)
+            if nch == 1:
+                flat, _ = step(init, (xb[0], lb[0], wb3[0]))
+            else:
+                flat, _ = lax.scan(step, init, (xb, lb, wb3))
+            return flat.reshape(Fc, hist_bins, W, 3).transpose(2, 0, 1, 3)
+
+        def best_of_many(hists_k, sums_k, depths_k, feature_mask, meta,
+                         bundle):
+            """vmapped packed best-split search over K children."""
+            def one(h, s, d):
+                b = find_best_split_impl(
+                    to_feature_hist(h, s, meta, bundle), s[0], s[1], s[2],
+                    meta, feature_mask, params)
+                if max_depth > 0:
+                    b = b.at[GAIN].set(jnp.where(d < max_depth, b[GAIN],
+                                                 -jnp.inf))
+                return b
+            return jax.vmap(one)(hists_k, sums_k, depths_k)
+
+        # ---- root
+        root_sums = maybe_psum(jnp.sum(w3, axis=0))
+        hist0 = maybe_psum(root_hist_fn(X, grad, hess, leaf_id, 0, row_mult,
+                                        num_bins=hist_bins))
+        Fh, B = hist0.shape[0], hist0.shape[1]
+        if cache_hists:
+            hists = jnp.zeros((L, Fh, B, 3), hist_dtype).at[0].set(hist0)
+        else:
+            hists = jnp.zeros((0,), hist_dtype)
+        bests = jnp.full((L, SPLIT_VEC_SIZE), -jnp.inf, dtype=hist_dtype)
+        bests = bests.at[0].set(best_of_many(
+            hist0[None], root_sums[None], jnp.zeros(1, jnp.int32),
+            feature_mask, meta, bundle)[0])
+        sums = jnp.zeros((L, 3), hist_dtype).at[0].set(root_sums)
+        tree = TreeArrays(
+            num_leaves=jnp.asarray(1, jnp.int32),
+            split_feature=jnp.zeros(L - 1, jnp.int32),
+            threshold_bin=jnp.zeros(L - 1, jnp.int32),
+            default_bin_for_zero=jnp.zeros(L - 1, jnp.int32),
+            default_bin=jnp.zeros(L - 1, jnp.int32),
+            is_cat=jnp.zeros(L - 1, jnp.int32),
+            left_child=jnp.zeros(L - 1, jnp.int32),
+            right_child=jnp.zeros(L - 1, jnp.int32),
+            split_gain=jnp.zeros(L - 1, hist_dtype),
+            internal_value=jnp.zeros(L - 1, hist_dtype),
+            internal_count=jnp.zeros(L - 1, jnp.int32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_value=jnp.zeros(L, hist_dtype),
+            leaf_count=jnp.zeros(L, jnp.int32).at[0].set(
+                root_sums[2].astype(jnp.int32)),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+        )
+
+        def cond(carry):
+            nn, done = carry[0], carry[1]
+            return (nn < L - 1) & ~done
+
+        def body(carry):
+            nn, done, leaf_id, hists, bests, sums, tree = carry
+            gains = bests[:, GAIN]
+            budget = (L - 1) - nn
+            gw, lw = lax.top_k(gains, W)
+            rank = jnp.arange(W, dtype=jnp.int32)
+            valid = (gw > 0.0) & (rank < budget)
+            k = jnp.sum(valid.astype(jnp.int32))
+            parent = lw.astype(jnp.int32)          # distinct leaf ids
+            info = bests[parent]                   # (W, V)
+            node = nn + rank                       # internal node ids
+            newleaf = node + 1                     # right-child leaf ids
+
+            f_w = info[:, FEATURE].astype(jnp.int32)
+            thr_w = info[:, THRESHOLD].astype(jnp.int32)
+            dbz_w = info[:, DEFAULT_BIN_FOR_ZERO].astype(jnp.int32)
+            cat_w = info[:, IS_CAT] > 0.5
+            fdef_w = meta.default_bin[f_w]
+            dleft_w = jnp.where(cat_w, dbz_w == thr_w, dbz_w <= thr_w)
+
+            # ---- per-leaf split tables, fused into one (L, K) f32 matrix
+            # (all entries < 2^24, exact in f32) looked up per row by a
+            # one-hot contraction — no row gathers anywhere
+            src = jnp.where(valid, parent, L)      # L -> dropped
+            if has_bundle:
+                col_w = bundle.group_of[f_w]
+                goff_w = bundle.bin_off[f_w]
+                adj_w = bundle.bin_adj[f_w]
+                span_w = bundle.bin_span[f_w]
+            else:
+                col_w = f_w
+                goff_w = jnp.zeros(W, jnp.int32)
+                adj_w = jnp.zeros(W, jnp.int32)
+                span_w = jnp.full(W, num_bins, jnp.int32)
+            cols = jnp.stack([
+                jnp.ones(W, jnp.float32),                  # 0: active
+                col_w.astype(jnp.float32),                 # 1: device column
+                thr_w.astype(jnp.float32),                 # 2: threshold bin
+                cat_w.astype(jnp.float32),                 # 3: categorical
+                fdef_w.astype(jnp.float32),                # 4: default bin
+                dleft_w.astype(jnp.float32),               # 5: default left
+                newleaf.astype(jnp.float32),               # 6: right leaf id
+                goff_w.astype(jnp.float32),                # 7: group offset
+                adj_w.astype(jnp.float32),                 # 8: bin adjust
+                span_w.astype(jnp.float32),                # 9: bin span
+            ], axis=-1)                                    # (W, 10)
+            tbl = jnp.zeros((L, 10), jnp.float32).at[src].set(
+                cols, mode="drop")
+
+            # ---- fused partition + children histograms (one sweep)
+            left_small = info[:, LEFT_COUNT] < info[:, RIGHT_COUNT]
+            small_id = jnp.where(left_small, parent, newleaf)
+            large_id = jnp.where(left_small, newleaf, parent)
+            leaf_id, hist_small = wave_pass(leaf_id, tbl, small_id, valid)
+            hist_small = maybe_psum(hist_small)             # (W, F, B, 3)
+            if cache_hists:
+                hist_large = hists[parent] - hist_small
+                hsrc = jnp.where(valid, small_id, L)
+                hists = hists.at[hsrc].set(hist_small, mode="drop")
+                lsrc = jnp.where(valid, large_id, L)
+                hists = hists.at[lsrc].set(hist_large, mode="drop")
+            else:
+                hist_large = maybe_psum(rehist(leaf_id, large_id, valid))
+
+            left_sums = jnp.stack([info[:, LEFT_SUM_G], info[:, LEFT_SUM_H],
+                                   info[:, LEFT_COUNT]], axis=-1)
+            right_sums = jnp.stack([info[:, RIGHT_SUM_G],
+                                    info[:, RIGHT_SUM_H],
+                                    info[:, RIGHT_COUNT]], axis=-1)
+            small_sums = jnp.where(left_small[:, None], left_sums,
+                                   right_sums)
+            large_sums = jnp.where(left_small[:, None], right_sums,
+                                   left_sums)
+
+            # ---- vectorized split search for all 2W children
+            depth = tree.leaf_depth[parent] + 1             # (W,)
+            hists_k = jnp.concatenate([hist_small, hist_large])
+            sums_k = jnp.concatenate([small_sums, large_sums])
+            depths_k = jnp.concatenate([depth, depth])
+            bests_k = best_of_many(hists_k, sums_k, depths_k, feature_mask,
+                                   meta, bundle)            # (2W, V)
+            ssrc = jnp.where(valid, small_id, L)
+            lsrc2 = jnp.where(valid, large_id, L)
+            bests = bests.at[ssrc].set(bests_k[:W], mode="drop")
+            bests = bests.at[lsrc2].set(bests_k[W:], mode="drop")
+            sums = sums.at[ssrc].set(small_sums, mode="drop")
+            sums = sums.at[lsrc2].set(large_sums, mode="drop")
+
+            # ---- tree bookkeeping, vectorized over the wave
+            nsrc = jnp.where(valid, node, L - 1 + 64)       # drop sentinel
+            tparent = tree.leaf_parent[parent]              # (W,)
+            # grandparent child-pointer fix: each split's (parent node,
+            # side) slot is unique, so the W scatters cannot collide
+            gp = jnp.maximum(tparent, 0)
+            was_left = tree.left_child[gp] == ~parent
+            fix = valid & (tparent >= 0)
+            lc = tree.left_child.at[jnp.where(fix & was_left, gp, L + 63)
+                                    ].set(node, mode="drop")
+            rc = tree.right_child.at[jnp.where(fix & ~was_left, gp, L + 63)
+                                     ].set(node, mode="drop")
+            lc = lc.at[nsrc].set(~parent, mode="drop")
+            rc = rc.at[nsrc].set(~newleaf, mode="drop")
+            lsrc3 = jnp.where(valid, parent, L)
+            rsrc3 = jnp.where(valid, newleaf, L)
+            tree = tree._replace(
+                num_leaves=tree.num_leaves + k,
+                split_feature=tree.split_feature.at[nsrc].set(
+                    f_w, mode="drop"),
+                threshold_bin=tree.threshold_bin.at[nsrc].set(
+                    thr_w, mode="drop"),
+                default_bin_for_zero=tree.default_bin_for_zero.at[nsrc].set(
+                    dbz_w, mode="drop"),
+                default_bin=tree.default_bin.at[nsrc].set(
+                    fdef_w, mode="drop"),
+                is_cat=tree.is_cat.at[nsrc].set(
+                    cat_w.astype(jnp.int32), mode="drop"),
+                left_child=lc,
+                right_child=rc,
+                split_gain=tree.split_gain.at[nsrc].set(
+                    info[:, GAIN], mode="drop"),
+                internal_value=tree.internal_value.at[nsrc].set(
+                    tree.leaf_value[parent], mode="drop"),
+                internal_count=tree.internal_count.at[nsrc].set(
+                    (info[:, LEFT_COUNT]
+                     + info[:, RIGHT_COUNT]).astype(jnp.int32),
+                    mode="drop"),
+                leaf_parent=tree.leaf_parent.at[lsrc3].set(
+                    node, mode="drop").at[rsrc3].set(node, mode="drop"),
+                leaf_value=tree.leaf_value.at[lsrc3].set(
+                    info[:, LEFT_OUTPUT], mode="drop").at[rsrc3].set(
+                        info[:, RIGHT_OUTPUT], mode="drop"),
+                leaf_count=tree.leaf_count.at[lsrc3].set(
+                    info[:, LEFT_COUNT].astype(jnp.int32),
+                    mode="drop").at[rsrc3].set(
+                        info[:, RIGHT_COUNT].astype(jnp.int32), mode="drop"),
+                leaf_depth=tree.leaf_depth.at[lsrc3].set(
+                    depth, mode="drop").at[rsrc3].set(depth, mode="drop"),
+            )
+            return (nn + k, k == 0, leaf_id, hists, bests, sums, tree)
+
+        carry = (jnp.asarray(0, jnp.int32), jnp.asarray(False), leaf_id,
+                 hists, bests, sums, tree)
+        carry = lax.while_loop(cond, body, carry)
+        return carry[-1], carry[2]
+
+    return grow

@@ -117,15 +117,28 @@ class DataParallelTreeLearner(SerialTreeLearner):
         local_rows = (n + pad) // n_shards
         caps = (default_row_capacities(local_rows)
                 if self.row_capacities else ())   # same gate, per-shard rows
-        grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
-                            self.params, config.max_depth,
-                            hist_mode=self.hist_mode, hist_dtype=self.dtype,
-                            psum_axis=DATA_AXIS,
-                            bundle=self.bundle_arrays,
-                            group_bins=self.group_bins,
-                            row_capacities=caps,
-                            cache_hists=self.cache_hists,
-                            **self._grow_kwargs(n_shards))
+        voting = bool(self._grow_kwargs(n_shards).get("voting_k", 0))
+        if self.growth == "wave" and not voting:
+            # wave schedule under the data mesh: the per-wave histogram
+            # block is psum'd ONCE (W splits per collective instead of one)
+            from ..ops.wave import make_wave_grow_fn
+            grow = make_wave_grow_fn(
+                self.num_leaves, self.num_bins, self.meta, self.params,
+                config.max_depth, wave_width=self.wave_width,
+                hist_dtype=self.dtype, psum_axis=DATA_AXIS,
+                bundle=self.bundle_arrays, group_bins=self.group_bins,
+                cache_hists=self.cache_hists, hist_mode=self.hist_mode)
+        else:
+            grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
+                                self.params, config.max_depth,
+                                hist_mode=self.hist_mode,
+                                hist_dtype=self.dtype,
+                                psum_axis=DATA_AXIS,
+                                bundle=self.bundle_arrays,
+                                group_bins=self.group_bins,
+                                row_capacities=caps,
+                                cache_hists=self.cache_hists,
+                                **self._grow_kwargs(n_shards))
         sharded_grow = _shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),

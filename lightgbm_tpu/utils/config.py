@@ -131,6 +131,7 @@ PARAMETER_SET = {
     "capacity",
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
+    "tpu_growth", "tpu_wave_width",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -318,6 +319,16 @@ class Config:
         "tpu_use_dp": ("bool", False),
         # 'auto' | 'scatter' | 'onehot' | 'pallas' — histogram kernel
         "tpu_histogram_mode": ("str", "auto"),
+        # 'auto' | 'exact' | 'wave' — growth schedule (ops/wave.py):
+        # 'exact' is the reference's one-split-at-a-time leaf-wise order;
+        # 'wave' batches the top-W pending splits per sweep for the MXU.
+        # auto -> wave on TPU, exact elsewhere.
+        "tpu_growth": ("str", "auto"),
+        # W in 'wave' growth: splits the top-W pending leaves per sweep.
+        # The default (16) approximates the leaf-wise ORDER (same greedy
+        # frontier, batched; quality parity in tests/test_wave.py); set 1
+        # to reproduce the reference's exact split sequence.
+        "tpu_wave_width": ("int", 16),
     }
 
     # keys accepted for config-file compatibility whose behavior differs
