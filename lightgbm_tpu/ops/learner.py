@@ -156,6 +156,9 @@ class SerialTreeLearner:
         # batched; quality parity shown in tests/test_wave.py) — set
         # tpu_wave_width=1 for the reference's exact split sequence.
         growth = config.tpu_growth
+        if growth not in ("auto", "exact", "wave"):
+            Log.fatal("Unknown tpu_growth %s (expected auto/exact/wave)",
+                      growth)
         if growth == "auto":
             growth = ("wave" if jax.default_backend() == "tpu"
                       and hist_mode != "pallas" else "exact")

@@ -111,10 +111,11 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     n, fc = X.shape
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    # bins per inner sub-block: ~512 lanes per one-hot tile, a power of two
-    # so it divides bp (64 or a multiple of 128)
+    # bins per inner sub-block: ~512 lanes per one-hot tile, and a DIVISOR
+    # of bp so the sub-block loop covers every bin (bp can be 384 etc. —
+    # powers of two do not always divide it)
     bsub = 1
-    while bsub * 2 * fc <= 512 and bsub * 2 <= bp:
+    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
         bsub *= 2
     # keep the (Cg, bsub*fc) f32/bf16 tiles within ~16MB each so a handful
     # of live temporaries fit the raised VMEM budget; bigger row tiles

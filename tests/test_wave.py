@@ -159,7 +159,7 @@ def test_wave_data_parallel_matches_serial():
     sl = SerialTreeLearner(serial_cfg, td)
     dp = DataParallelTreeLearner(cfg2, td,
                                  make_data_mesh(jax.devices()[:4]))
-    assert sl.growth == "wave" or sl.growth == "exact"
+    assert sl.growth == "wave" and dp.growth == "wave"
     g = np.asarray(grad, np.float32)
     h = np.asarray(hess, np.float32)
     ts, _ = sl.train_device(g, h)

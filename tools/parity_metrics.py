@@ -1,0 +1,62 @@
+"""Shared metric computations for the training-parity harness.
+
+One metric implementation evaluates BOTH frameworks' prediction files, so
+the reference-vs-lightgbm_tpu comparison (docs/GPU-Performance.md:134-145
+CPU-vs-GPU pattern) cannot be skewed by metric-code differences.
+"""
+import numpy as np
+
+
+def load_tsv(path):
+    data = np.loadtxt(path, delimiter="\t")
+    return data[:, 0], data[:, 1:]
+
+
+def load_query(path):
+    return np.loadtxt(path, dtype=int).reshape(-1)
+
+
+def logloss(y, p, eps=1e-15):
+    p = np.clip(p, eps, 1 - eps)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def auc(y, p):
+    order = np.argsort(p, kind="mergesort")
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    # average ranks over ties so AUC is exact
+    for v in np.unique(p):
+        m = p == v
+        if m.sum() > 1:
+            ranks[m] = ranks[m].mean()
+    npos = y.sum()
+    nneg = len(y) - npos
+    return float((ranks[y > 0].sum() - npos * (npos + 1) / 2)
+                 / (npos * nneg))
+
+
+def rmse(y, p):
+    return float(np.sqrt(np.mean((y - p) ** 2)))
+
+
+def ndcg_at(y, p, counts, k):
+    """NDCG@k with LightGBM's 2^label - 1 gains (metric/dcg_calculator)."""
+    out, pos = [], 0
+    for c in counts:
+        yy, pp = y[pos:pos + c], p[pos:pos + c]
+        pos += c
+        kk = min(k, c)
+        disc = 1.0 / np.log2(np.arange(2, kk + 2))
+        dcg = float(((2 ** yy[np.argsort(-pp, kind="mergesort")][:kk] - 1)
+                     * disc).sum())
+        idcg = float(((2 ** np.sort(yy)[::-1][:kk] - 1) * disc).sum())
+        if idcg > 0:
+            out.append(dcg / idcg)
+    return float(np.mean(out))
+
+
+def multi_logloss(y, prob, eps=1e-15):
+    prob = np.clip(prob, eps, 1.0)
+    n = len(y)
+    return float(-np.mean(np.log(prob[np.arange(n), y.astype(int)])))
