@@ -27,9 +27,16 @@ __all__ = ["Dataset", "Booster", "LightGBMError"]
 
 
 def _data_from_any(data, label=None):
-    """Accept numpy 2-D, pandas DataFrame, list-of-lists, or file path."""
+    """Accept numpy 2-D, pandas DataFrame, scipy sparse, list-of-lists, or
+    file path.  Sparse inputs stay sparse (io/sparse.py) — they are binned
+    column-by-column without densification."""
     if isinstance(data, str):
         return data, label
+    from .io.sparse import SparseColumns, from_scipy, is_scipy_sparse
+    if isinstance(data, SparseColumns):
+        return data, label
+    if is_scipy_sparse(data):
+        return from_scipy(data), label
     try:
         import pandas as pd
         if isinstance(data, pd.DataFrame):
@@ -83,7 +90,10 @@ class Dataset:
                 self._handle = TrainingData.from_file(self.data, cfg,
                                                       reference=ref_td)
         else:
-            data = np.asarray(self.data, dtype=np.float64)
+            from .io.sparse import SparseColumns
+            sparse = isinstance(self.data, SparseColumns)
+            data = self.data if sparse else np.asarray(self.data,
+                                                      dtype=np.float64)
             if self.feature_name not in (None, "auto"):
                 feature_names = list(self.feature_name)
             if self.categorical_feature not in (None, "auto"):
@@ -113,21 +123,37 @@ class Dataset:
             if self.reference is not None:
                 self.reference.construct()
                 ref_td = self.reference._handle
-            self._handle = TrainingData.from_matrix(
-                data, label=self.label, config=cfg,
-                weights=self.weight, group=self.group,
-                init_score=self.init_score,
-                categorical_feature=cat, feature_names=feature_names,
-                reference=ref_td, keep_raw=True)
+            if sparse:
+                self._handle = TrainingData.from_csc(
+                    data, label=self.label, config=cfg,
+                    weights=self.weight, group=self.group,
+                    init_score=self.init_score,
+                    categorical_feature=cat, feature_names=feature_names,
+                    reference=ref_td)
+            else:
+                self._handle = TrainingData.from_matrix(
+                    data, label=self.label, config=cfg,
+                    weights=self.weight, group=self.group,
+                    init_score=self.init_score,
+                    categorical_feature=cat, feature_names=feature_names,
+                    reference=ref_td, keep_raw=True)
         if self.label is not None and self._handle.metadata.label is None:
             self._handle.metadata.set_label(self.label)
         if not self.free_raw_data and isinstance(self.data, np.ndarray):
             self._handle.raw_data = self.data
         # continued-training predictor fills init scores
         # (engine.py:92-98 / dataset predict_fun_ path)
-        if self._predictor is not None and self._handle.raw_data is not None:
-            raw = self._predictor.predict_raw_for_init(self._handle.raw_data)
-            self._handle.metadata.set_init_score(raw.T.reshape(-1))
+        if self._predictor is not None:
+            from .io.sparse import SparseColumns, iter_dense_row_chunks
+            if self._handle.raw_data is not None:
+                raw = self._predictor.predict_raw_for_init(
+                    self._handle.raw_data)
+                self._handle.metadata.set_init_score(raw.T.reshape(-1))
+            elif isinstance(self.data, SparseColumns):
+                raw = np.concatenate(
+                    [self._predictor.predict_raw_for_init(block)
+                     for _, block in iter_dense_row_chunks(self.data)])
+                self._handle.metadata.set_init_score(raw.T.reshape(-1))
         return self
 
     def create_valid(self, data, label=None, weight=None, group=None,
@@ -144,6 +170,18 @@ class Dataset:
     def subset(self, used_indices, params=None) -> "Dataset":
         self.construct()
         used_indices = np.asarray(used_indices)
+        from .io.sparse import SparseColumns
+        if isinstance(self.data, SparseColumns):
+            sub = Dataset(self.data.take_rows(used_indices),
+                          label=None if self.label is None
+                          else np.asarray(self.label)[used_indices],
+                          reference=self,
+                          weight=None if self.weight is None
+                          else np.asarray(self.weight)[used_indices],
+                          params=params or self.params,
+                          free_raw_data=self.free_raw_data)
+            sub.used_indices = used_indices
+            return sub
         if self._handle.raw_data is None:
             Log.fatal("Cannot subset a Dataset whose raw data was freed")
         sub = Dataset(self._handle.raw_data[used_indices],
@@ -493,6 +531,17 @@ class Booster:
             mat = parsed.features
         else:
             mat, _ = _data_from_any(data)
+            from .io.sparse import SparseColumns, iter_dense_row_chunks
+            if isinstance(mat, SparseColumns):
+                # bounded-memory sparse prediction: densify row chunks
+                # (tree traversal wants raw values, O(chunk * F) at a time)
+                outs = [self._gbdt.predict(block,
+                                           num_iteration=num_iteration,
+                                           raw_score=raw_score,
+                                           pred_leaf=pred_leaf)
+                        for _, block in iter_dense_row_chunks(mat)]
+                return (np.concatenate(outs) if outs
+                        else np.zeros(0, dtype=np.float64))
             mat = np.asarray(mat, dtype=np.float64)
             if mat.ndim == 1:
                 mat = mat.reshape(1, -1)

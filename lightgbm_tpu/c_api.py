@@ -49,20 +49,24 @@ def _get_pushing(handle: int):
 
 
 def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
+    """Small-chunk densify (streaming push-rows only; bulk creation goes
+    through the sparse path, io/sparse.py)."""
+    indptr = np.asarray(indptr, dtype=np.int64)
     n = len(indptr) - 1
     mat = np.zeros((n, num_col), dtype=np.float64)
-    for r in range(n):
-        for j in range(indptr[r], indptr[r + 1]):
-            mat[r, indices[j]] = data[j]
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    mat[rows, np.asarray(indices, dtype=np.int64)] = \
+        np.asarray(data, dtype=np.float64)
     return mat
 
 
 def _csc_to_dense(colptr, indices, data, num_row: int) -> np.ndarray:
+    colptr = np.asarray(colptr, dtype=np.int64)
     num_col = len(colptr) - 1
     mat = np.zeros((num_row, num_col), dtype=np.float64)
-    for c in range(num_col):
-        for j in range(colptr[c], colptr[c + 1]):
-            mat[indices[j], c] = data[j]
+    cols = np.repeat(np.arange(num_col), np.diff(colptr))
+    mat[np.asarray(indices, dtype=np.int64), cols] = \
+        np.asarray(data, dtype=np.float64)
     return mat
 
 
@@ -100,17 +104,27 @@ def LGBM_DatasetCreateFromMat(data, parameters: str = "",
 def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col: int,
                               parameters: str = "",
                               reference: Optional[int] = None) -> int:
-    return LGBM_DatasetCreateFromMat(_csr_to_dense(indptr, indices, data,
-                                                   num_col),
-                                     parameters, reference)
+    """Sparse create stays sparse (sparse_bin.hpp:68 analog): rows are
+    re-sorted to CSC and binned per column — no N x F densification."""
+    from .io.sparse import csr_to_csc
+    sp = csr_to_csc(indptr, indices, data, num_col)
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(sp, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    return _register(ds)
 
 
 def LGBM_DatasetCreateFromCSC(colptr, indices, data, num_row: int,
                               parameters: str = "",
                               reference: Optional[int] = None) -> int:
-    return LGBM_DatasetCreateFromMat(_csc_to_dense(colptr, indices, data,
-                                                   num_row),
-                                     parameters, reference)
+    from .io.sparse import csc_arrays
+    sp = csc_arrays(colptr, indices, data, num_row)
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(sp, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    return _register(ds)
 
 
 def LGBM_DatasetSetField(handle: int, field_name: str, data) -> int:
@@ -448,16 +462,20 @@ def LGBM_BoosterGetFeatureNames(handle: int) -> List[str]:
 def LGBM_BoosterPredictForCSR(handle: int, indptr, indices, data,
                               num_col: int, predict_type: int = 0,
                               num_iteration: int = -1):
-    return LGBM_BoosterPredictForMat(handle,
-                                     _csr_to_dense(indptr, indices, data,
-                                                   num_col),
-                                     predict_type, num_iteration)
+    from .io.sparse import csr_to_csc, iter_dense_row_chunks
+    sp = csr_to_csc(indptr, indices, data, num_col)
+    outs = [LGBM_BoosterPredictForMat(handle, block, predict_type,
+                                      num_iteration)
+            for _, block in iter_dense_row_chunks(sp)]
+    return np.concatenate(outs) if outs else np.zeros(0, dtype=np.float64)
 
 
 def LGBM_BoosterPredictForCSC(handle: int, colptr, indices, data,
                               num_row: int, predict_type: int = 0,
                               num_iteration: int = -1):
-    return LGBM_BoosterPredictForMat(handle,
-                                     _csc_to_dense(colptr, indices, data,
-                                                   num_row),
-                                     predict_type, num_iteration)
+    from .io.sparse import csc_arrays, iter_dense_row_chunks
+    sp = csc_arrays(colptr, indices, data, num_row)
+    outs = [LGBM_BoosterPredictForMat(handle, block, predict_type,
+                                      num_iteration)
+            for _, block in iter_dense_row_chunks(sp)]
+    return np.concatenate(outs) if outs else np.zeros(0, dtype=np.float64)

@@ -104,6 +104,146 @@ class TrainingData:
         return self
 
     @classmethod
+    def from_csc(cls, sp, label=None, config: Optional[Config] = None,
+                 weights=None, group=None, init_score=None,
+                 categorical_feature: Sequence[int] = (),
+                 feature_names: Optional[List[str]] = None,
+                 reference: Optional["TrainingData"] = None) -> "TrainingData":
+        """Sparse ingestion without densification (SparseBin analog,
+        sparse_bin.hpp:68 + dataset_loader.cpp:840-930).
+
+        sp: io.sparse.SparseColumns.  Bin mappers are constructed from
+        per-column NONZERO samples (zeros are implicit in find_bin's total
+        count, exactly as the dense path drops them), and binned columns
+        are written as a default-bin fill plus a nonzero scatter.  Peak
+        host memory is O(nnz + N*F_used bin bytes) — the N x F float64
+        matrix never exists.
+        """
+        config = config or Config()
+        self = cls()
+        n = sp.num_row
+        self.num_data = n
+        self.num_total_features = sp.num_col
+        self.max_bin = config.max_bin
+        self.feature_names = list(feature_names) if feature_names else [
+            "Column_%d" % i for i in range(sp.num_col)]
+        cats = set(int(c) for c in categorical_feature)
+
+        if reference is not None:
+            if sp.num_col != reference.num_total_features:
+                Log.fatal("Validation data has %d features, train data "
+                          "has %d", sp.num_col,
+                          reference.num_total_features)
+            self._copy_binning_from(reference)
+        else:
+            sample_cnt = min(config.bin_construct_sample_cnt, n)
+            rng = Random(config.data_random_seed)
+            sample_idx = rng.sample(n, sample_cnt)
+            if len(sample_idx) == 0:
+                sample_idx = np.arange(n, dtype=np.int32)
+            total_sample = len(sample_idx)
+            # row -> sample position (or -1), so each column's sampled
+            # nonzeros come from one O(col_nnz) lookup
+            sample_pos = np.full(n, -1, dtype=np.int64)
+            sample_pos[np.asarray(sample_idx, dtype=np.int64)] = \
+                np.arange(total_sample)
+            filter_cnt = int(config.min_data_in_leaf * total_sample
+                             / max(n, 1))
+
+            self.bin_mappers = []
+            col_sample_cache = []
+            for f in range(sp.num_col):
+                rows, vals = sp.column(f)
+                pos = sample_pos[rows]
+                sel = pos >= 0
+                sv, spos = vals[sel], pos[sel]
+                # the cache keeps NaN entries: the dense EFB sample bins
+                # them to the last bin via value_to_bin, and the sparse
+                # sample must agree; only find_bin drops them (the dense
+                # mapper-construction path does the same)
+                col_sample_cache.append((spos, sv))
+                fb = sv[~np.isnan(sv)]
+                m = BinMapper()
+                bin_type = CATEGORICAL if f in cats else NUMERICAL
+                m.find_bin(fb[fb != 0.0], total_sample, config.max_bin,
+                           config.min_data_in_bin, filter_cnt, bin_type)
+                self.bin_mappers.append(m)
+
+            self.used_feature_idx = [
+                i for i, m in enumerate(self.bin_mappers)
+                if m is not None and not m.is_trivial]
+            if not self.used_feature_idx:
+                Log.warning("There are no meaningful features, as all "
+                            "feature values are constant.")
+            self.real_to_inner = {r: i for i, r in
+                                  enumerate(self.used_feature_idx)}
+            self._build_feature_arrays()
+
+            # EFB on the binning sample, rebuilt sparsely (dense path:
+            # Dataset::Construct, dataset.cpp:229-235)
+            if (config.enable_bundle and len(self.used_feature_idx) > 1
+                    and config.tree_learner not in ("feature",
+                                                    "feature_parallel")):
+                binned_sample = np.empty(
+                    (total_sample, len(self.used_feature_idx)), np.int64)
+                for i, r in enumerate(self.used_feature_idx):
+                    mapper = self.bin_mappers[r]
+                    col = np.full(total_sample,
+                                  self.default_bin_arr[i], np.int64)
+                    spos, sv = col_sample_cache[r]
+                    if len(spos):
+                        col[spos] = mapper.value_to_bin(sv)
+                    binned_sample[:, i] = col
+                self.bundle = find_feature_groups(
+                    binned_sample, self.num_bin_arr, self.default_bin_arr,
+                    config.max_conflict_rate, config.min_data_in_leaf,
+                    self.num_data)
+                if self.bundle is not None:
+                    Log.info("EFB bundled %d features into %d groups",
+                             len(self.used_feature_idx),
+                             self.bundle.num_groups)
+            del col_sample_cache
+
+        self._bin_sparse(sp)
+        if label is not None:
+            self.metadata.set_label(label)
+        else:
+            self.metadata.num_data = n
+        if weights is not None:
+            self.metadata.set_weights(weights)
+        if group is not None:
+            self.metadata.set_query_counts(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        return self
+
+    def _bin_sparse(self, sp) -> None:
+        """Binned matrix from CSC columns: default-bin fill + nonzero
+        scatter per column (never a dense float64 intermediate)."""
+        n = sp.num_row
+        f_used = len(self.used_feature_idx)
+
+        def dense_binned_col(i):
+            r = self.used_feature_idx[i]
+            mapper = self.bin_mappers[r]
+            rows, vals = sp.column(r)
+            col = np.full(n, mapper.value_to_bin(0.0), dtype=np.int64)
+            if len(rows):
+                col[rows] = mapper.value_to_bin(vals)
+            return col
+
+        if self.bundle is not None:
+            self.binned = bin_rows_grouped(dense_binned_col, self.bundle,
+                                           self.default_bin_arr)
+            return
+        max_num_bin = int(self.num_bin_arr.max()) if f_used else 2
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        out = np.empty((n, f_used), dtype=dtype)
+        for i in range(f_used):
+            out[:, i] = dense_binned_col(i).astype(dtype)
+        self.binned = out
+
+    @classmethod
     def from_file(cls, filename: str, config: Optional[Config] = None,
                   reference: Optional["TrainingData"] = None,
                   keep_raw: bool = False) -> "TrainingData":
@@ -267,12 +407,9 @@ class TrainingData:
                              len(self.used_feature_idx),
                              self.bundle.num_groups)
 
-    def _align_with(self, reference: "TrainingData", data: np.ndarray) -> None:
-        """Valid set shares the train set's mappers
-        (dataset_loader.cpp:220-261 CreateValid path)."""
-        if data.shape[1] != reference.num_total_features:
-            Log.fatal("Validation data has %d features, train data has %d",
-                      data.shape[1], reference.num_total_features)
+    def _copy_binning_from(self, reference: "TrainingData") -> None:
+        """Share the train set's binning state (mappers, used features,
+        per-feature arrays, EFB layout) — dataset_loader.cpp:220-261."""
         self.bin_mappers = reference.bin_mappers
         self.used_feature_idx = list(reference.used_feature_idx)
         self.real_to_inner = dict(reference.real_to_inner)
@@ -281,6 +418,14 @@ class TrainingData:
         self.is_categorical_arr = reference.is_categorical_arr
         self.max_bin = reference.max_bin
         self.bundle = reference.bundle
+
+    def _align_with(self, reference: "TrainingData", data: np.ndarray) -> None:
+        """Valid set shares the train set's mappers
+        (dataset_loader.cpp:220-261 CreateValid path)."""
+        if data.shape[1] != reference.num_total_features:
+            Log.fatal("Validation data has %d features, train data has %d",
+                      data.shape[1], reference.num_total_features)
+        self._copy_binning_from(reference)
         self._bin_data(data)
 
     def _build_feature_arrays(self) -> None:

@@ -23,13 +23,15 @@ def logloss(y, p, eps=1e-15):
 
 def auc(y, p):
     order = np.argsort(p, kind="mergesort")
+    sp = p[order]
     ranks = np.empty(len(p))
-    ranks[order] = np.arange(1, len(p) + 1)
-    # average ranks over ties so AUC is exact
-    for v in np.unique(p):
-        m = p == v
-        if m.sum() > 1:
-            ranks[m] = ranks[m].mean()
+    # tie-averaged ranks in O(N log N): equal-value runs share their mean
+    base = np.arange(1, len(p) + 1, dtype=np.float64)
+    starts = np.flatnonzero(np.concatenate(([True], sp[1:] != sp[:-1])))
+    run_sums = np.add.reduceat(base, starts)
+    run_lens = np.diff(np.concatenate((starts, [len(p)])))
+    mean_per_run = run_sums / run_lens
+    ranks[order] = np.repeat(mean_per_run, run_lens)
     npos = y.sum()
     nneg = len(y) - npos
     return float((ranks[y > 0].sum() - npos * (npos + 1) / 2)
