@@ -83,6 +83,22 @@ def pad_rows(n: int, num_shards: int) -> int:
     return (-n) % num_shards
 
 
+def make_row_sharded(mesh: Mesh, host_local: np.ndarray, extra_dims=0):
+    """A row-sharded global jax.Array from host data.
+
+    Single-process: a plain device_put.  Multi-process (jax.distributed
+    initialized, the DCN path replacing linkers_socket.cpp): `host_local`
+    is THIS process's row shard and the global array is assembled from the
+    per-process shards — rows must already be padded so every process
+    contributes the same count.
+    """
+    spec = P(DATA_AXIS, *([None] * extra_dims))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(host_local, sharding)
+    return jax.make_array_from_process_local_data(sharding, host_local)
+
+
 class DataParallelTreeLearner(SerialTreeLearner):
     """Row-sharded learner; one psum per histogram construction.
 
@@ -97,24 +113,31 @@ class DataParallelTreeLearner(SerialTreeLearner):
                  mesh: Optional[Mesh] = None):
         self.mesh = mesh if mesh is not None else make_data_mesh()
         n_shards = self.mesh.devices.size
-        n = train_data.num_data
-        pad = pad_rows(n, n_shards)
+        self._nproc = jax.process_count()
+        n = train_data.num_data        # multi-process: THIS process's rows
+        if self._nproc > 1 and n_shards % self._nproc != 0:
+            Log.fatal("Data mesh of %d devices cannot be split across %d "
+                      "processes evenly", n_shards, self._nproc)
+        # every process must contribute identically-shaped shards (equal
+        # per-process row counts pre-partitioned by the caller, padded to
+        # the per-process shard quantum here)
+        local_shards = max(n_shards // self._nproc, 1)
+        pad = pad_rows(n, local_shards)
         self._pad = pad
         binned = train_data.binned
         if pad:
             binned = np.concatenate(
                 [binned, np.zeros((pad, binned.shape[1]), binned.dtype)])
-        x_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
-        X_dev = jax.device_put(binned, x_sharding)
+        X_dev = make_row_sharded(self.mesh, binned, extra_dims=1)
         super().__init__(config, train_data, psum_axis=DATA_AXIS,
                          device_data=X_dev)
         self._row_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        self._ones = jax.device_put(
+        self._ones = make_row_sharded(
+            self.mesh,
             np.concatenate([np.ones(n, np.float32),
-                            np.zeros(pad, np.float32)]).astype(self.dtype),
-            self._row_sharding)
+                            np.zeros(pad, np.float32)]).astype(self.dtype))
         from ..ops.grow import default_row_capacities
-        local_rows = (n + pad) // n_shards
+        local_rows = (n + pad) // local_shards
         caps = (default_row_capacities(local_rows)
                 if self.row_capacities else ())   # same gate, per-shard rows
         voting = bool(self._grow_kwargs(n_shards).get("voting_k", 0))
@@ -159,13 +182,29 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return TreeArrays(*([0] * len(TreeArrays._fields)))
 
     def _pad_rows_dev(self, arr, fill=0.0):
-        arr = jnp.asarray(arr, self.dtype)
+        if isinstance(arr, jax.Array) and arr.ndim == 1 \
+                and arr.shape[0] == self.X.shape[0] \
+                and arr.dtype == self.dtype:
+            return arr          # already a (global) row-sharded device array
+        if self._nproc == 1:
+            # async on-device pad + placement (no host round-trip: the
+            # boosting loop stays fully pipelined, gbdt.py:344-350)
+            arr = jnp.asarray(arr, self.dtype)
+            if self._pad:
+                arr = jnp.concatenate(
+                    [arr, jnp.full((self._pad,), fill, self.dtype)])
+            return jax.device_put(arr, self._row_sharding)
+        arr = np.asarray(arr, self.dtype)     # local shard -> global array
         if self._pad:
-            arr = jnp.concatenate(
-                [arr, jnp.full((self._pad,), fill, self.dtype)])
-        return jax.device_put(arr, self._row_sharding)
+            arr = np.concatenate(
+                [arr, np.full((self._pad,), fill, self.dtype)])
+        return make_row_sharded(self.mesh, arr)
 
     def train_device(self, grad, hess, row_mult=None, feature_mask=None):
+        """Grow one tree.  Multi-process callers drive this directly with
+        GLOBAL row-sharded arrays (tests/mp_worker.py is the model; the
+        Booster/GBDT layer is a single-controller API) and get the global
+        row->leaf map back; single-process callers pass host arrays."""
         grad = self._pad_rows_dev(grad)
         hess = self._pad_rows_dev(hess)
         if row_mult is None:
@@ -175,6 +214,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if feature_mask is None:
             feature_mask = self.sample_feature_mask()
         tree, leaf_id = self._grow(self.X, grad, hess, row_mult, feature_mask)
+        if self._nproc > 1:
+            return tree, leaf_id     # global, matches global score arrays
         return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id
 
 

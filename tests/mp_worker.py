@@ -1,0 +1,108 @@
+"""Worker for the 2-process DCN test (tests/test_multiprocess.py).
+
+Each process: jax.distributed.initialize over a localhost coordinator
+(the TPU-native replacement for machine_list_file + socket handshake,
+linkers_socket.cpp), distributed bin finding via JaxProcessComm
+(dataset_loader.cpp:733-833 analog), then data-parallel boosting over the
+GLOBAL mesh spanning both processes — histograms psum across the process
+boundary exactly as they would across DCN on a multi-host pod.
+
+Prints one JSON line with the final model fingerprint + local AUC so the
+parent can assert cross-rank agreement and the single-process oracle.
+
+Usage: mp_worker.py <coordinator> <num_procs> <rank>
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+N_GLOBAL, F, ROUNDS = 4096, 8, 3
+
+
+def make_data(rank, nproc):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_GLOBAL, F))
+    y = (X[:, 0] + np.sin(X[:, 1] * 2) + 0.4 * rng.normal(size=N_GLOBAL)
+         > 0).astype(np.float32)
+    per = N_GLOBAL // nproc
+    sl = slice(rank * per, (rank + 1) * per)
+    return X[sl], y[sl]
+
+
+def main():
+    # env + backend setup ONLY when run as a worker process: importing this
+    # module (the test does, for make_data) must not touch global jax state
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    coordinator, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nproc, process_id=rank)
+    import jax.numpy as jnp
+    from lightgbm_tpu.io.dataset import TrainingData
+    from lightgbm_tpu.models.tree import Tree
+    from lightgbm_tpu.ops import predict as dev_predict
+    from lightgbm_tpu.parallel.comm import JaxProcessComm
+    from lightgbm_tpu.parallel.mesh import (DataParallelTreeLearner,
+                                            make_data_mesh,
+                                            make_row_sharded)
+    from lightgbm_tpu.utils.config import Config
+
+    assert jax.process_count() == nproc
+    X_local, y_local = make_data(rank, nproc)
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5, "max_bin": 63,
+                  "verbose": -1, "tpu_growth": "exact",
+                  "enable_bundle": False})
+    comm = JaxProcessComm()
+    # distributed bin finding across REAL processes
+    td = TrainingData.from_matrix(X_local, label=y_local, config=cfg,
+                                  comm=comm)
+    mesh = make_data_mesh()              # global mesh over both processes
+    learner = DataParallelTreeLearner(cfg, td, mesh)
+
+    y_dev = make_row_sharded(mesh, y_local.astype(np.float32))
+    score = make_row_sharded(mesh, np.zeros(len(y_local), np.float32))
+    lr = jnp.asarray(0.2, jnp.float32)
+
+    @jax.jit
+    def grads(score, y):
+        p = 1.0 / (1.0 + jnp.exp(-score))
+        return p - y, p * (1.0 - p)
+
+    trees = []
+    for _ in range(ROUNDS):
+        g, h = grads(score, y_dev)
+        tree_dev, leaf_id = learner.train_device(g, h)
+        score = dev_predict.update_score_from_partition(
+            score, leaf_id, tree_dev.leaf_value, lr)
+        trees.append(tree_dev)
+
+    # fingerprint: structure of every tree (replicated outputs, addressable
+    # on all processes) + this rank's local AUC
+    fp = []
+    for t in trees:
+        fp.append({
+            "num_leaves": int(jax.device_get(t.num_leaves)),
+            "split_feature": np.asarray(
+                jax.device_get(t.split_feature)).tolist(),
+            "threshold_bin": np.asarray(
+                jax.device_get(t.threshold_bin)).tolist(),
+            "leaf_value_sum": float(np.asarray(
+                jax.device_get(t.leaf_value)).sum()),
+        })
+    local_score = np.concatenate(
+        [np.asarray(s.data) for s in score.addressable_shards])
+    order = np.argsort(local_score)
+    ranks = np.empty(len(order)); ranks[order] = np.arange(1, len(order) + 1)
+    npos = y_local.sum(); nneg = len(y_local) - npos
+    auc = float((ranks[y_local > 0].sum() - npos * (npos + 1) / 2)
+                / (npos * nneg))
+    print("MPRESULT " + json.dumps({"rank": rank, "trees": fp,
+                                    "auc": round(auc, 6)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
