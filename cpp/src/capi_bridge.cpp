@@ -1,0 +1,445 @@
+// LGBM_* C ABI as a real shared library — liblgbm_tpu_capi.so.
+//
+// Parity target: include/LightGBM/c_api.h:37-719 (the reference exports
+// its C API from lib_lightgbm.so so every non-Python binding can link).
+// Here the data plane and training run in the Python/JAX runtime, so the
+// ABI is a thin embedding bridge: each exported symbol acquires the
+// CPython GIL (initializing an interpreter if the host process has none),
+// wraps the caller's raw buffers as memoryviews, and forwards to the
+// _abi_* adapters in lightgbm_tpu/c_api.py.  Handles are the registry
+// integers from c_api.py cast through void*.
+//
+// Standalone (non-Python) hosts must have lightgbm_tpu importable
+// (PYTHONPATH) — the same deployment shape as the reference needing its
+// lib on LD_LIBRARY_PATH.  tests/test_c_abi.py drives this library via
+// ctypes, mirroring the reference's tests/c_api_test/test.py.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+static thread_local std::string g_last_error;
+
+namespace {
+
+std::once_flag g_py_init_once;
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() {
+    // first caller wins the interpreter bootstrap; concurrent first calls
+    // from a threaded C host must not double-initialize
+    std::call_once(g_py_init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // drop the GIL acquired by initialization so Ensure below nests
+        PyEval_SaveThread();
+      }
+    });
+    state = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+PyObject* api_module() {
+  static PyObject* mod = nullptr;   // borrowed forever (GIL-protected init)
+  if (!mod) {
+    mod = PyImport_ImportModule("lightgbm_tpu.c_api");
+  }
+  return mod;
+}
+
+void capture_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = where;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* text = PyUnicode_AsUTF8(s);
+      if (text) {
+        msg += ": ";
+        msg += text;
+      }
+      Py_DECREF(s);
+    }
+  }
+  g_last_error = msg;
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// call adapter fn; returns new reference or nullptr (error captured)
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* mod = api_module();
+  if (!mod) {
+    capture_error(fn);
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) {
+    capture_error(fn);
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!r) capture_error(fn);
+  return r;
+}
+
+PyObject* mv(const void* ptr, Py_ssize_t nbytes) {
+  return PyMemoryView_FromMemory((char*)ptr, nbytes, PyBUF_READ);
+}
+
+Py_ssize_t dtype_size(int code) {
+  switch (code) {
+    case 0: return 4;   // float32
+    case 1: return 8;   // float64
+    case 2: return 4;   // int32
+    default: return 8;  // int64
+  }
+}
+
+int handle_of(PyObject* r, void** out) {
+  if (!r) return -1;
+  long h = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (h == -1 && PyErr_Occurred()) {
+    capture_error("handle");
+    return -1;
+  }
+  *out = (void*)(intptr_t)h;
+  return 0;
+}
+
+long as_handle(void* h) { return (long)(intptr_t)h; }
+
+// copy a float64 ndarray (buffer protocol) into out, set out_len
+int copy_f64(PyObject* r, int64_t* out_len, double* out_result) {
+  if (!r) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(r, &view, PyBUF_CONTIG_RO) != 0) {
+    capture_error("result buffer");
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_ssize_t n = view.len / (Py_ssize_t)sizeof(double);
+  std::memcpy(out_result, view.buf, (size_t)view.len);
+  if (out_len) *out_len = (int64_t)n;
+  PyBuffer_Release(&view);
+  Py_DECREF(r);
+  return 0;
+}
+
+int ret_ok(PyObject* r) {
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ssl)", filename, parameters ? parameters : "",
+                                 as_handle((void*)reference));
+  return handle_of(call("_abi_dataset_from_file", args), out);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          const DatasetHandle reference,
+                                          DatasetHandle* out) {
+  Gil gil;
+  if (!is_row_major) {
+    g_last_error = "column-major matrices are not supported";
+    return -1;
+  }
+  Py_ssize_t nbytes = (Py_ssize_t)nrow * ncol * dtype_size(data_type);
+  PyObject* args = Py_BuildValue(
+      "(Niiisl)", mv(data, nbytes), (int)nrow, (int)ncol, data_type,
+      parameters ? parameters : "", as_handle((void*)reference));
+  return handle_of(call("_abi_dataset_from_mat", args), out);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NLiNNLiLsl)", mv(indptr, nindptr * dtype_size(indptr_type)),
+      (long long)nindptr, indptr_type,
+      mv(indices, nelem * (Py_ssize_t)sizeof(int32_t)),
+      mv(data, nelem * dtype_size(data_type)), (long long)nelem, data_type,
+      (long long)num_col, parameters ? parameters : "",
+      as_handle((void*)reference));
+  return handle_of(call("_abi_dataset_from_csr", args), out);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NLiNNLiLsl)", mv(col_ptr, ncol_ptr * dtype_size(col_ptr_type)),
+      (long long)ncol_ptr, col_ptr_type,
+      mv(indices, nelem * (Py_ssize_t)sizeof(int32_t)),
+      mv(data, nelem * dtype_size(data_type)), (long long)nelem, data_type,
+      (long long)num_row, parameters ? parameters : "",
+      as_handle((void*)reference));
+  return handle_of(call("_abi_dataset_from_csc", args), out);
+}
+
+LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
+  Gil gil;
+  return ret_ok(call("LGBM_DatasetFree",
+                     Py_BuildValue("(l)", as_handle(handle))));
+}
+
+LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                     const char* field_name,
+                                     const void* field_data,
+                                     int64_t num_element, int type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(lsNLi)", as_handle(handle), field_name,
+      mv(field_data, num_element * dtype_size(type)),
+      (long long)num_element, type);
+  return ret_ok(call("_abi_dataset_set_field", args));
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle,
+                                       int64_t* out) {
+  Gil gil;
+  PyObject* r = call("LGBM_DatasetGetNumData",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  *out = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumFeature(DatasetHandle handle,
+                                          int64_t* out) {
+  Gil gil;
+  PyObject* r = call("LGBM_DatasetGetNumFeature",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  *out = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                       const char* filename) {
+  Gil gil;
+  return ret_ok(call("LGBM_DatasetSaveBinary",
+                     Py_BuildValue("(ls)", as_handle(handle), filename)));
+}
+
+LGBM_EXPORT int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                   const char* parameters,
+                                   BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ls)", as_handle((void*)train_data),
+                                 parameters ? parameters : "");
+  return handle_of(call("LGBM_BoosterCreate", args), out);
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  Gil gil;
+  if (handle_of(call("LGBM_BoosterCreateFromModelfile",
+                     Py_BuildValue("(s)", filename)), out) != 0)
+    return -1;
+  PyObject* r = call("LGBM_BoosterGetCurrentIteration",
+                     Py_BuildValue("(l)", as_handle(*out)));
+  if (!r) return -1;
+  if (out_num_iterations) *out_num_iterations = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  Gil gil;
+  if (handle_of(call("LGBM_BoosterLoadModelFromString",
+                     Py_BuildValue("(s)", model_str)), out) != 0)
+    return -1;
+  PyObject* r = call("LGBM_BoosterGetCurrentIteration",
+                     Py_BuildValue("(l)", as_handle(*out)));
+  if (!r) return -1;
+  if (out_num_iterations) *out_num_iterations = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(BoosterHandle handle) {
+  Gil gil;
+  return ret_ok(call("LGBM_BoosterFree",
+                     Py_BuildValue("(l)", as_handle(handle))));
+}
+
+LGBM_EXPORT int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                         const DatasetHandle valid_data) {
+  Gil gil;
+  return ret_ok(call("LGBM_BoosterAddValidData",
+                     Py_BuildValue("(ll)", as_handle(handle),
+                                   as_handle((void*)valid_data))));
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                          int* is_finished) {
+  Gil gil;
+  PyObject* r = call("LGBM_BoosterUpdateOneIter",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  if (is_finished) *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  Gil gil;
+  return ret_ok(call("LGBM_BoosterRollbackOneIter",
+                     Py_BuildValue("(l)", as_handle(handle))));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                                int* out_iteration) {
+  Gil gil;
+  PyObject* r = call("LGBM_BoosterGetCurrentIteration",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  *out_iteration = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(BoosterHandle handle,
+                                          int* out_len) {
+  Gil gil;
+  PyObject* r = call("LGBM_BoosterGetNumClasses",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  *out_len = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalCounts(BoosterHandle handle,
+                                          int* out_len) {
+  Gil gil;
+  PyObject* r = call("LGBM_BoosterGetEvalCounts",
+                     Py_BuildValue("(l)", as_handle(handle)));
+  if (!r) return -1;
+  *out_len = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                    int* out_len, double* out_results) {
+  Gil gil;
+  int64_t n = 0;
+  PyObject* r = call("_abi_booster_get_eval",
+                     Py_BuildValue("(li)", as_handle(handle), data_idx));
+  if (copy_f64(r, &n, out_results) != 0) return -1;
+  if (out_len) *out_len = (int)n;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                      int num_iteration,
+                                      const char* filename) {
+  Gil gil;
+  return ret_ok(call("LGBM_BoosterSaveModel",
+                     Py_BuildValue("(lis)", as_handle(handle),
+                                   num_iteration, filename)));
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                              int num_iteration,
+                                              int64_t buffer_len,
+                                              int64_t* out_len,
+                                              char* out_str) {
+  Gil gil;
+  PyObject* r = call("_abi_booster_save_model_string",
+                     Py_BuildValue("(li)", as_handle(handle),
+                                   num_iteration));
+  if (!r) return -1;
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  if (!s) {
+    capture_error("model string");
+    Py_DECREF(r);
+    return -1;
+  }
+  if (out_len) *out_len = (int64_t)n + 1;
+  if (out_str && buffer_len > 0) {
+    Py_ssize_t c = n + 1 <= buffer_len ? n + 1 : buffer_len;
+    std::memcpy(out_str, s, (size_t)(c - 1));
+    out_str[c - 1] = '\0';
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMat(
+    BoosterHandle handle, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int is_row_major, int predict_type, int num_iteration,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  if (!is_row_major) {
+    g_last_error = "column-major matrices are not supported";
+    return -1;
+  }
+  Py_ssize_t nbytes = (Py_ssize_t)nrow * ncol * dtype_size(data_type);
+  PyObject* args = Py_BuildValue(
+      "(lNiiiii)", as_handle(handle), mv(data, nbytes), (int)nrow,
+      (int)ncol, data_type, predict_type, num_iteration);
+  return copy_f64(call("_abi_booster_predict_mat", args), out_len,
+                  out_result);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(lNLiNNLiLii)", as_handle(handle),
+      mv(indptr, nindptr * dtype_size(indptr_type)), (long long)nindptr,
+      indptr_type, mv(indices, nelem * (Py_ssize_t)sizeof(int32_t)),
+      mv(data, nelem * dtype_size(data_type)), (long long)nelem, data_type,
+      (long long)num_col, predict_type, num_iteration);
+  return copy_f64(call("_abi_booster_predict_csr", args), out_len,
+                  out_result);
+}

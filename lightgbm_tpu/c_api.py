@@ -479,3 +479,87 @@ def LGBM_BoosterPredictForCSC(handle: int, colptr, indices, data,
                                       num_iteration)
             for _, block in iter_dense_row_chunks(sp)]
     return np.concatenate(outs) if outs else np.zeros(0, dtype=np.float64)
+
+
+# ------------------------------------------------------------- C ABI bridge
+# Buffer-based adapters for the native shared library
+# (cpp/src/capi_bridge.cpp).  The .so embeds CPython and forwards each
+# exported LGBM_* symbol here, passing raw caller memory as memoryviews —
+# these shims give them numpy form with the C_API_DTYPE_* codes of
+# include/LightGBM/c_api.h:16-22.
+
+_DTYPE_BY_CODE = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _np_from_buffer(mv, count, dtype_code):
+    # COPY: the caller's C buffer is only valid for the duration of the
+    # call, but datasets/metadata retain arrays (free_raw_data=False,
+    # Metadata.set_label) — a view would dangle after the C side frees it
+    return np.frombuffer(mv, dtype=_DTYPE_BY_CODE[int(dtype_code)],
+                         count=int(count)).copy()
+
+
+def _abi_dataset_from_file(filename, parameters, ref_handle):
+    return LGBM_DatasetCreateFromFile(filename, parameters,
+                                      ref_handle or None)
+
+
+def _abi_dataset_from_mat(mv, nrow, ncol, dtype_code, parameters,
+                          ref_handle):
+    mat = _np_from_buffer(mv, nrow * ncol, dtype_code).reshape(nrow, ncol)
+    return LGBM_DatasetCreateFromMat(mat, parameters, ref_handle or None)
+
+
+def _abi_dataset_from_csr(mv_indptr, n_indptr, indptr_code, mv_indices,
+                          mv_data, nnz, data_code, num_col, parameters,
+                          ref_handle):
+    indptr = _np_from_buffer(mv_indptr, n_indptr, indptr_code)
+    indices = _np_from_buffer(mv_indices, nnz, 2)
+    data = _np_from_buffer(mv_data, nnz, data_code)
+    return LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col,
+                                     parameters, ref_handle or None)
+
+
+def _abi_dataset_from_csc(mv_colptr, n_colptr, colptr_code, mv_indices,
+                          mv_data, nnz, data_code, num_row, parameters,
+                          ref_handle):
+    colptr = _np_from_buffer(mv_colptr, n_colptr, colptr_code)
+    indices = _np_from_buffer(mv_indices, nnz, 2)
+    data = _np_from_buffer(mv_data, nnz, data_code)
+    return LGBM_DatasetCreateFromCSC(colptr, indices, data, num_row,
+                                     parameters, ref_handle or None)
+
+
+def _abi_dataset_set_field(handle, field_name, mv, count, dtype_code):
+    return LGBM_DatasetSetField(handle, field_name,
+                                _np_from_buffer(mv, count, dtype_code))
+
+
+def _abi_booster_get_eval(handle, data_idx):
+    return np.asarray(LGBM_BoosterGetEval(handle, data_idx),
+                      dtype=np.float64)
+
+
+def _abi_booster_predict_mat(handle, mv, nrow, ncol, dtype_code,
+                             predict_type, num_iteration):
+    mat = _np_from_buffer(mv, nrow * ncol, dtype_code).reshape(nrow, ncol)
+    out = LGBM_BoosterPredictForMat(handle, mat, predict_type,
+                                    num_iteration)
+    return np.ascontiguousarray(np.asarray(out, dtype=np.float64)
+                                .reshape(-1))
+
+
+def _abi_booster_predict_csr(handle, mv_indptr, n_indptr, indptr_code,
+                             mv_indices, mv_data, nnz, data_code, num_col,
+                             predict_type, num_iteration):
+    indptr = _np_from_buffer(mv_indptr, n_indptr, indptr_code)
+    indices = _np_from_buffer(mv_indices, nnz, 2)
+    data = _np_from_buffer(mv_data, nnz, data_code)
+    out = LGBM_BoosterPredictForCSR(handle, indptr, indices, data, num_col,
+                                    predict_type, num_iteration)
+    return np.ascontiguousarray(np.asarray(out, dtype=np.float64)
+                                .reshape(-1))
+
+
+def _abi_booster_save_model_string(handle, num_iteration):
+    return LGBM_BoosterSaveModelToString(handle, num_iteration)

@@ -118,6 +118,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self._nproc > 1 and n_shards % self._nproc != 0:
             Log.fatal("Data mesh of %d devices cannot be split across %d "
                       "processes evenly", n_shards, self._nproc)
+        if self._nproc > 1 and n % max(n_shards // self._nproc, 1) != 0:
+            # global arrays must align with the caller's global score/grad
+            # buffers; implicit tail padding would desync their lengths
+            Log.fatal("Multi-process training needs local rows (%d) "
+                      "pre-padded to a multiple of the per-process shard "
+                      "count (%d)", n, max(n_shards // self._nproc, 1))
         # every process must contribute identically-shaped shards (equal
         # per-process row counts pre-partitioned by the caller, padded to
         # the per-process shard quantum here)
