@@ -171,14 +171,11 @@ LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
                                           const DatasetHandle reference,
                                           DatasetHandle* out) {
   Gil gil;
-  if (!is_row_major) {
-    g_last_error = "column-major matrices are not supported";
-    return -1;
-  }
   Py_ssize_t nbytes = (Py_ssize_t)nrow * ncol * dtype_size(data_type);
   PyObject* args = Py_BuildValue(
-      "(Niiisl)", mv(data, nbytes), (int)nrow, (int)ncol, data_type,
-      parameters ? parameters : "", as_handle((void*)reference));
+      "(Niiiisl)", mv(data, nbytes), (int)nrow, (int)ncol, data_type,
+      is_row_major, parameters ? parameters : "",
+      as_handle((void*)reference));
   return handle_of(call("_abi_dataset_from_mat", args), out);
 }
 
@@ -416,14 +413,10 @@ LGBM_EXPORT int LGBM_BoosterPredictForMat(
     int32_t ncol, int is_row_major, int predict_type, int num_iteration,
     int64_t* out_len, double* out_result) {
   Gil gil;
-  if (!is_row_major) {
-    g_last_error = "column-major matrices are not supported";
-    return -1;
-  }
   Py_ssize_t nbytes = (Py_ssize_t)nrow * ncol * dtype_size(data_type);
   PyObject* args = Py_BuildValue(
-      "(lNiiiii)", as_handle(handle), mv(data, nbytes), (int)nrow,
-      (int)ncol, data_type, predict_type, num_iteration);
+      "(lNiiiiii)", as_handle(handle), mv(data, nbytes), (int)nrow,
+      (int)ncol, data_type, is_row_major, predict_type, num_iteration);
   return copy_f64(call("_abi_booster_predict_mat", args), out_len,
                   out_result);
 }

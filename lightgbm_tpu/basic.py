@@ -430,8 +430,7 @@ class Booster:
             cfg = Config(dict(self.params))
             gb = self._gbdt
             if gb.train_data is not None:
-                gb.reset_training_data(cfg, gb.train_data, gb.objective,
-                                       gb.training_metrics)
+                gb.reset_config(cfg)     # in place: scores/dataset kept
             else:
                 gb.config = cfg
         return self

@@ -491,12 +491,15 @@ def LGBM_BoosterPredictForCSC(handle: int, colptr, indices, data,
 _DTYPE_BY_CODE = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
 
 
-def _np_from_buffer(mv, count, dtype_code):
-    # COPY: the caller's C buffer is only valid for the duration of the
-    # call, but datasets/metadata retain arrays (free_raw_data=False,
-    # Metadata.set_label) — a view would dangle after the C side frees it
-    return np.frombuffer(mv, dtype=_DTYPE_BY_CODE[int(dtype_code)],
-                         count=int(count)).copy()
+def _np_from_buffer(mv, count, dtype_code, copy=True):
+    # COPY by default: the caller's C buffer is only valid for the
+    # duration of the call, but datasets/metadata retain arrays
+    # (free_raw_data=False, Metadata.set_label) — a view would dangle
+    # after the C side frees it.  Pure prediction paths pass copy=False
+    # (nothing retains the matrix past the synchronous call).
+    arr = np.frombuffer(mv, dtype=_DTYPE_BY_CODE[int(dtype_code)],
+                        count=int(count))
+    return arr.copy() if copy else arr
 
 
 def _abi_dataset_from_file(filename, parameters, ref_handle):
@@ -504,9 +507,11 @@ def _abi_dataset_from_file(filename, parameters, ref_handle):
                                       ref_handle or None)
 
 
-def _abi_dataset_from_mat(mv, nrow, ncol, dtype_code, parameters,
-                          ref_handle):
-    mat = _np_from_buffer(mv, nrow * ncol, dtype_code).reshape(nrow, ncol)
+def _abi_dataset_from_mat(mv, nrow, ncol, dtype_code, is_row_major,
+                          parameters, ref_handle):
+    mat = _np_from_buffer(mv, nrow * ncol, dtype_code)
+    mat = (mat.reshape(nrow, ncol) if is_row_major
+           else mat.reshape(ncol, nrow).T)
     return LGBM_DatasetCreateFromMat(mat, parameters, ref_handle or None)
 
 
@@ -541,8 +546,10 @@ def _abi_booster_get_eval(handle, data_idx):
 
 
 def _abi_booster_predict_mat(handle, mv, nrow, ncol, dtype_code,
-                             predict_type, num_iteration):
-    mat = _np_from_buffer(mv, nrow * ncol, dtype_code).reshape(nrow, ncol)
+                             is_row_major, predict_type, num_iteration):
+    mat = _np_from_buffer(mv, nrow * ncol, dtype_code, copy=False)
+    mat = (mat.reshape(nrow, ncol) if is_row_major
+           else mat.reshape(ncol, nrow).T)
     out = LGBM_BoosterPredictForMat(handle, mat, predict_type,
                                     num_iteration)
     return np.ascontiguousarray(np.asarray(out, dtype=np.float64)
@@ -552,9 +559,9 @@ def _abi_booster_predict_mat(handle, mv, nrow, ncol, dtype_code,
 def _abi_booster_predict_csr(handle, mv_indptr, n_indptr, indptr_code,
                              mv_indices, mv_data, nnz, data_code, num_col,
                              predict_type, num_iteration):
-    indptr = _np_from_buffer(mv_indptr, n_indptr, indptr_code)
-    indices = _np_from_buffer(mv_indices, nnz, 2)
-    data = _np_from_buffer(mv_data, nnz, data_code)
+    indptr = _np_from_buffer(mv_indptr, n_indptr, indptr_code, copy=False)
+    indices = _np_from_buffer(mv_indices, nnz, 2, copy=False)
+    data = _np_from_buffer(mv_data, nnz, data_code, copy=False)
     out = LGBM_BoosterPredictForCSR(handle, indptr, indices, data, num_col,
                                     predict_type, num_iteration)
     return np.ascontiguousarray(np.asarray(out, dtype=np.float64)

@@ -95,6 +95,38 @@ class GBDT:
                                      training_metrics)
 
     # ----------------------------------------------------------------- setup
+    def reset_config(self, config: Config) -> None:
+        """GBDT::ResetConfig (gbdt.cpp:64-74): re-read training
+        hyperparameters IN PLACE — training scores and the device-resident
+        dataset are untouched, so a per-iteration reset_parameter callback
+        costs one learner rebuild, not an O(num_trees) score replay plus a
+        dataset re-upload (that full path is reset_training_data)."""
+        # flush pending device trees first: _materialize stacks them, and
+        # trees grown under the old num_leaves must not mix shapes with
+        # trees grown under the new one
+        self._materialize()
+        self.config = config
+        self.early_stopping_round = config.early_stopping_round
+        self.shrinkage_rate = config.learning_rate
+        from ..ops.learner import SerialTreeLearner
+        from ..parallel.mesh import create_tree_learner
+        old = self.learner
+        if (type(old) is SerialTreeLearner
+                and old.X.shape[0]
+                == self.train_data.num_data + old._row_pad):
+            # reuse the uploaded (padded) bin matrix — no host->device
+            # transfer on a hyperparameter reset
+            self.learner = SerialTreeLearner(config, self.train_data,
+                                             device_data=old.X,
+                                             device_row_pad=old._row_pad)
+        else:
+            self.learner = create_tree_learner(config, self.train_data)
+        # bagging state (gbdt.cpp ResetBaggingConfig, :134-160)
+        self.bag_data_cnt = self.num_data
+        self.row_mult = None
+        if config.bagging_fraction < 1.0 and config.bagging_freq > 0:
+            self.bag_data_cnt = int(config.bagging_fraction * self.num_data)
+
     def reset_training_data(self, config: Config, train_data: TrainingData,
                             objective: Optional[ObjectiveFunction],
                             training_metrics: Sequence[Metric]) -> None:

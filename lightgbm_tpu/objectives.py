@@ -376,39 +376,54 @@ class LambdarankNDCG(ObjectiveFunction):
     def _build_device_layout(self) -> None:
         """Padded per-query layout for the jitted gradient program.
 
-        Every query becomes one row of a (num_queries, Qmax) table; rows are
-        contiguous ranges of the score vector (query_boundaries), so the
-        result is read back with a single N-element gather instead of a
-        scatter.  This is the `vmap over padded query segments` design of
+        Queries are BUCKETED by padded width (powers of two): each bucket
+        is a (Qb, w) table, so total table memory is O(sum of padded query
+        sizes) <= 2N — one 5000-doc query among 500k small ones costs its
+        own tiny bucket instead of widening every row to 5000.  Within a
+        bucket the design is the `vmap over padded query segments` of
         SURVEY.md §7 step 4 replacing rank_objective.hpp:19-244's per-query
-        OMP loop.
+        OMP loop; a handful of bucket-shaped jit calls per iteration
+        replaces the reference's single loop.
         """
         counts = np.diff(self.qb)
-        qmax = max(int(counts.max()) if len(counts) else 1, 2)
         nq = self.num_queries
-        slot = np.arange(qmax)[None, :]
-        self._dev_valid = jnp.asarray(slot < counts[:, None])
-        idx = self.qb[:-1, None] + slot                  # (Q, qmax)
-        idx = np.minimum(idx, self.num_data - 1)         # clamp padding
-        self._dev_idx = jnp.asarray(idx.astype(np.int32))
-        self._dev_labels = jnp.asarray(
-            np.where(slot < counts[:, None],
-                     self.labels_np[idx].astype(np.int32), 0))
-        self._dev_counts = jnp.asarray(counts.astype(np.int32))
-        self._dev_inv_max_dcg = jnp.asarray(
-            self.inverse_max_dcgs.astype(np.float32))
-        self._dev_discounts = jnp.asarray(
-            get_discounts(qmax).astype(np.float32))
         self._dev_label_gain = jnp.asarray(self.label_gain.astype(np.float32))
-        # inverse map: row i of the score vector -> (its query, offset)
-        rq = np.repeat(np.arange(nq, dtype=np.int64), counts)
-        ro = np.arange(self.num_data, dtype=np.int64) - self.qb[:-1][rq]
-        self._dev_flat_back = jnp.asarray((rq * qmax + ro).astype(np.int32))
-        # block the query axis so the pairwise (qmax, qmax) tensors stay
-        # bounded: ~64MB of f32 pair matrices per block
-        blk = max(1, min(nq, int(16_000_000 // (qmax * qmax)) or 1))
-        self._dev_block = blk
         self._dev_sigmoid = float(self.sigmoid)
+        widths = np.maximum(
+            2, 2 ** np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64))
+        self._buckets = []
+        for w in np.unique(widths):
+            qs = np.flatnonzero(widths == w)
+            c = counts[qs]
+            w = int(w)
+            slot = np.arange(w)[None, :]
+            valid = slot < c[:, None]
+            idx = self.qb[:-1][qs][:, None] + slot       # (Qb, w)
+            idx = np.minimum(idx, self.num_data - 1)     # clamp padding
+            labels = np.where(valid,
+                              self.labels_np[idx].astype(np.int32), 0)
+            # this bucket's score-vector rows, and their table slots, in
+            # matching (row-major) order — the device program returns the
+            # per-row values and the caller scatters them into (N,)
+            qi, si = np.nonzero(valid)
+            rows = idx[valid]
+            tabpos = qi * w + si
+            # block the query axis so the pairwise (w, w) tensors stay
+            # bounded: ~64MB of f32 pair matrices per block
+            blk = max(1, min(len(qs), int(16_000_000 // (w * w)) or 1))
+            self._buckets.append({
+                "idx": jnp.asarray(idx.astype(np.int32)),
+                "valid": jnp.asarray(valid),
+                "labels": jnp.asarray(labels),
+                "counts": jnp.asarray(c.astype(np.int32)),
+                "inv": jnp.asarray(
+                    self.inverse_max_dcgs[qs].astype(np.float32)),
+                "discounts": jnp.asarray(
+                    get_discounts(w).astype(np.float32)),
+                "rows": jnp.asarray(rows.astype(np.int32)),
+                "tabpos": jnp.asarray(tabpos.astype(np.int32)),
+                "block": blk,
+            })
 
     def get_gradients(self, score):
         """Jitted padded-query lambdas — no host round-trip per iteration.
@@ -416,11 +431,16 @@ class LambdarankNDCG(ObjectiveFunction):
         The numpy implementation (get_gradients_host) is kept as the oracle
         for tests/test_objectives parity checks.
         """
-        lam, hes = _lambdarank_device(
-            jnp.asarray(score, jnp.float32), self._dev_idx, self._dev_valid,
-            self._dev_labels, self._dev_counts, self._dev_inv_max_dcg,
-            self._dev_discounts, self._dev_label_gain, self._dev_flat_back,
-            self._dev_sigmoid, self._dev_block)
+        score = jnp.asarray(score, jnp.float32)
+        lam = jnp.zeros(self.num_data, jnp.float32)
+        hes = jnp.zeros(self.num_data, jnp.float32)
+        for b in self._buckets:
+            lb, hb = _lambdarank_device(
+                score, b["idx"], b["valid"], b["labels"], b["counts"],
+                b["inv"], b["discounts"], self._dev_label_gain,
+                b["tabpos"], self._dev_sigmoid, b["block"])
+            lam = lam.at[b["rows"]].set(lb)
+            hes = hes.at[b["rows"]].set(hb)
         return _apply_weights(lam, hes, self.weights)
 
     def get_gradients_host(self, score):
@@ -510,8 +530,10 @@ def _lambdarank_one_query(s, labels, cnt, inv_max_dcg, discounts,
 
 @functools.partial(jax.jit, static_argnums=(9, 10))
 def _lambdarank_device(score, idx, valid, labels, counts, inv_max_dcg,
-                       discounts, label_gain, flat_back, sigmoid,
+                       discounts, label_gain, tab_pos, sigmoid,
                        block):
+    """Per-bucket lambdas: (R,) values for the rows whose table slots are
+    tab_pos (callers scatter them back into the (N,) gradient vectors)."""
     from jax import lax
     nq, qmax = idx.shape
     s = jnp.where(valid, score[idx].astype(jnp.float32), -jnp.inf)
@@ -537,8 +559,8 @@ def _lambdarank_device(score, idx, valid, labels, counts, inv_max_dcg,
                         labels.reshape(nb, block, qmax),
                         counts.reshape(nb, block),
                         inv_max_dcg.reshape(nb, block)))
-    lam = lam.reshape(-1)[flat_back]               # (N,) gather-back
-    hes = hes.reshape(-1)[flat_back]
+    lam = lam.reshape(-1)[tab_pos]                 # (R,) gather-back
+    hes = hes.reshape(-1)[tab_pos]
     return lam, hes
 
 

@@ -95,7 +95,11 @@ def build_split_params(config: Config) -> SplitParams:
 
 class SerialTreeLearner:
     def __init__(self, config: Config, train_data: TrainingData,
-                 psum_axis: Optional[str] = None, device_data=None):
+                 psum_axis: Optional[str] = None, device_data=None,
+                 device_row_pad: int = 0):
+        """device_data: pre-uploaded (and possibly row-padded) bin matrix;
+        device_row_pad says how many trailing pad rows it carries so
+        row_mult/_ones stay aligned (reset_config's no-reupload reuse)."""
         self.config = config
         self.train_data = train_data
         self.num_leaves = config.num_leaves
@@ -104,7 +108,7 @@ class SerialTreeLearner:
         # round rows up to a quantum so nearby dataset sizes (cv folds,
         # retrains after appending data) land on the same compiled shape;
         # padded rows carry zero row_mult and change nothing
-        self._row_pad = 0
+        self._row_pad = device_row_pad
         if device_data is not None:
             self.X = device_data
         else:

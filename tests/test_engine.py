@@ -233,3 +233,28 @@ def test_infiniteboost():
     p = bst.predict(X)
     acc = ((p > 0.5) == (y > 0)).mean()
     assert acc > 0.85
+
+
+def test_reset_parameter_in_place():
+    """Booster.reset_parameter rebuilds hyperparameters without resetting
+    training state (GBDT::ResetConfig semantics): existing trees keep
+    contributing, and subsequent trees honor the new num_leaves."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(3000, 6))
+    y = (X[:, 0] + 0.5 * rng.normal(size=3000) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 5, "metric": "binary_logloss"}
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    for _ in range(3):
+        bst.update()
+    before = bst._gbdt.get_eval_at(0)[0]
+    bst.reset_parameter({"num_leaves": 7, "lambda_l2": 1.0})
+    for _ in range(3):
+        bst.update()
+    after = bst._gbdt.get_eval_at(0)[0]
+    assert after < before          # scores carried over, still improving
+    assert bst.num_trees() == 6
+    bst._gbdt._materialize()               # device trees -> host Tree objs
+    dumped = bst._gbdt.models
+    assert dumped[0].num_leaves > 7        # pre-reset trees: old width
+    assert dumped[-1].num_leaves <= 7      # post-reset trees: new width
