@@ -16,6 +16,7 @@ bin all rows -> metadata check.
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -263,13 +264,54 @@ class TrainingData:
                 label_idx = header_names.index(name)
             else:
                 label_idx = int(lc)
-        parsed = _parser.parse_file(filename, has_header=config.has_header,
-                                    label_idx=label_idx)
         feature_names = None
         if header_names:
             feature_names = [n for i, n in enumerate(header_names) if i != label_idx]
         categorical = _resolve_columns(config.categorical_column, feature_names)
         ignore = _resolve_columns(config.ignore_column, feature_names)
+
+        # streaming two-round loading (dataset_loader.cpp:554-660): pick it
+        # when asked for, or automatically for big dense files — the
+        # in-memory parser would otherwise materialize the whole text plus
+        # an N x F float64 matrix
+        from . import streaming as _streaming
+        file_bytes = 0
+        try:
+            file_bytes = os.path.getsize(filename)
+        except OSError:
+            pass
+        want_stream = (config.use_two_round_loading
+                       or file_bytes > (256 << 20)) and not keep_raw
+        if want_stream and _streaming.stream_supported(filename,
+                                                       config.has_header):
+            self = cls()
+            self.feature_names = feature_names or []
+            keep = None
+            if ignore:
+                # column count from the first data lines only (O(1) memory
+                # — the whole point of the streaming path)
+                with open(filename, "r") as fh:
+                    if config.has_header:
+                        fh.readline()
+                    head = [fh.readline() for _ in range(2)]
+                probe = _parser.parse_text(
+                    "".join(head), has_header=False, label_idx=label_idx)
+                keep = [i for i in range(probe.features.shape[1])
+                        if i not in ignore]
+                if feature_names:
+                    self.feature_names = [feature_names[i] for i in keep]
+                categorical = {keep.index(c) for c in categorical
+                               if c in keep}
+            _streaming.stream_load(self, filename, config, label_idx,
+                                   categorical, keep, reference=reference)
+            if not self.feature_names:
+                self.feature_names = ["Column_%d" % i
+                                      for i in range(self.num_total_features)]
+            self.metadata.init_from_file(filename)
+            return self
+
+        parsed = _parser.parse_file(filename, has_header=config.has_header,
+                                    label_idx=label_idx)
         data = parsed.features
         if ignore:
             keep = [i for i in range(data.shape[1]) if i not in ignore]
@@ -293,7 +335,14 @@ class TrainingData:
         if len(sample_idx) == 0:
             sample_idx = np.arange(n, dtype=np.int32)
         sample = data[sample_idx]
-        total_sample = len(sample_idx)
+        self._fit_mappers_from_sample(sample, config, categorical)
+
+    def _fit_mappers_from_sample(self, sample: np.ndarray, config: Config,
+                                 categorical: set) -> None:
+        """BinMapper construction from an already-drawn row sample (the
+        shared tail of one-round and streaming two-round loading)."""
+        n = self.num_data
+        total_sample = len(sample)
         # filter_cnt formula from dataset_loader.cpp:491-492
         filter_cnt = int(config.min_data_in_leaf * total_sample / max(n, 1))
 

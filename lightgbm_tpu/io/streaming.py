@@ -1,0 +1,174 @@
+"""Streaming two-round text ingest — bounded host memory at any file size.
+
+Parity target: the reference's two-round loading + pipelined reader
+(src/io/dataset_loader.cpp:554-660, include/LightGBM/utils/
+pipeline_reader.h:18): one pass samples rows for bin construction, the
+next pushes every row into pre-sized bins.  The in-memory parser
+(io/parser.py) materializes the whole file — ~8 GB of host RAM for the
+Higgs TSV before binning starts; this loader never holds more than one
+chunk of text plus the sample:
+
+  round 0  count rows (binary newline scan, ~GB/s, no float parsing)
+  round 1  re-read, keeping ONLY the sampled lines (string slicing;
+           floats parsed just for the sample) -> BinMapper construction
+           + EFB, identical to the in-memory path (same Random seed and
+           sample indices, so mappers match bit for bit)
+  round 2  re-read, parse each chunk, bin it straight into the
+           pre-allocated (N, F_used) uint8/16 matrix + label column
+
+Dense csv/tsv/space formats stream; libsvm falls back to the in-memory
+parser (its natural streaming form is the sparse path, io/sparse.py).
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+from ..utils.random import Random
+from . import parser as _parser
+
+CHUNK_BYTES = 64 << 20          # text chunk per read
+
+
+def _iter_line_chunks(filename: str, skip_header: bool):
+    """Yield (first_row_index, list_of_lines) per text chunk."""
+    row = 0
+    with open(filename, "r") as f:
+        if skip_header:
+            f.readline()
+        rest = ""
+        while True:
+            block = f.read(CHUNK_BYTES)
+            if not block:
+                if rest.strip():
+                    yield row, [rest]
+                return
+            block = rest + block
+            lines = block.split("\n")
+            rest = lines.pop()            # possibly incomplete tail
+            lines = [l for l in lines if l.strip()]
+            if lines:
+                yield row, lines
+                row += len(lines)
+
+
+def count_rows(filename: str, skip_header: bool) -> int:
+    """Number of NON-BLANK data lines — must agree exactly with what
+    _iter_line_chunks yields (blank lines are skipped everywhere, matching
+    the in-memory parser), so the count rides the same iterator."""
+    return sum(len(lines)
+               for _, lines in _iter_line_chunks(filename, skip_header))
+
+
+def _parse_lines(lines: List[str], sep: Optional[str]) -> np.ndarray:
+    buf = io.StringIO("\n".join(lines))
+    try:
+        return np.loadtxt(buf, delimiter=sep, dtype=np.float64, ndmin=2)
+    except ValueError:
+        rows = [[_parser._safe_float(t)
+                 for t in (l.split(sep) if sep else l.split())]
+                for l in lines]
+        return np.asarray(rows, dtype=np.float64)
+
+
+def stream_supported(filename: str, has_header: bool) -> bool:
+    with open(filename, "r") as f:
+        if has_header:
+            f.readline()
+        head = [f.readline().rstrip("\r\n") for _ in range(2)]
+    return _parser.detect_format([l for l in head if l]) != "libsvm"
+
+
+def stream_load(td, filename: str, config, label_idx: int,
+                categorical: set, keep: Optional[List[int]],
+                reference=None) -> None:
+    """Fill TrainingData `td` from a dense text file in bounded memory.
+
+    keep: post-label FEATURE column indices retained (ignore_column
+    support); None keeps all.  reference: share a train set's mappers
+    (validation alignment) and skip rounds 0-1's fitting.
+    """
+    skip_header = bool(config.has_header)
+    with open(filename, "r") as f:
+        if skip_header:
+            f.readline()
+        first = f.readline().rstrip("\r\n")
+    fmt = _parser.detect_format([first])
+    if fmt == "libsvm":
+        Log.fatal("stream_load handles dense formats; libsvm goes through "
+                  "the sparse path")
+    sep = _parser._SEP[fmt]
+
+    def to_features(mat):
+        if 0 <= label_idx < mat.shape[1]:
+            label = mat[:, label_idx].copy()
+            feats = np.delete(mat, label_idx, axis=1)
+        else:
+            label = np.zeros(mat.shape[0], dtype=np.float64)
+            feats = mat
+        if keep is not None:
+            feats = feats[:, keep]
+        return feats, label
+
+    # ---- round 0: row count
+    n = count_rows(filename, skip_header)
+    if n == 0:
+        Log.fatal("Data file %s is empty", filename)
+    td.num_data = n
+
+    ncols_probe, _ = to_features(_parse_lines([first], sep))
+    td.num_total_features = ncols_probe.shape[1]
+    td.max_bin = config.max_bin
+
+    if reference is not None:
+        if td.num_total_features != reference.num_total_features:
+            Log.fatal("Validation data has %d features, train data has %d",
+                      td.num_total_features, reference.num_total_features)
+        td._copy_binning_from(reference)
+    else:
+        # ---- round 1: sampled lines only (no full-file float parse)
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        rng = Random(config.data_random_seed)
+        sample_idx = np.asarray(rng.sample(n, sample_cnt))
+        if len(sample_idx) == 0:
+            sample_idx = np.arange(n, dtype=np.int32)
+        wanted = np.zeros(n, dtype=bool)
+        wanted[sample_idx] = True
+        picked: List[str] = []
+        for start, lines in _iter_line_chunks(filename, skip_header):
+            sel = np.flatnonzero(wanted[start:start + len(lines)])
+            picked.extend(lines[i] for i in sel)
+        sample_feats, _ = to_features(_parse_lines(picked, sep))
+        td._fit_mappers_from_sample(sample_feats, config, categorical)
+
+    # ---- round 2: bin chunk by chunk into the pre-sized matrix
+    from .bundle import bin_rows_grouped
+    f_used = len(td.used_feature_idx)
+    if td.bundle is not None:
+        out_cols = td.bundle.num_groups
+        gmax = int(td.bundle.num_group_bins.max(initial=2))
+        dtype = np.uint8 if gmax <= 256 else np.uint16
+    else:
+        out_cols = f_used
+        max_num_bin = int(td.num_bin_arr.max()) if f_used else 2
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    binned = np.zeros((n, out_cols), dtype=dtype)
+    label_out = np.zeros(n, dtype=np.float64)
+    for start, lines in _iter_line_chunks(filename, skip_header):
+        feats, label = to_features(_parse_lines(lines, sep))
+        e = start + len(lines)
+        label_out[start:e] = label
+        cols = np.empty((len(lines), f_used), dtype=np.int64)
+        for i, r in enumerate(td.used_feature_idx):
+            cols[:, i] = td.bin_mappers[r].value_to_bin(feats[:, r])
+        if td.bundle is not None:
+            binned[start:e] = bin_rows_grouped(cols, td.bundle,
+                                               td.default_bin_arr)
+        else:
+            binned[start:e] = cols.astype(dtype)
+    td.binned = binned
+    td.metadata.set_label(label_out)

@@ -337,9 +337,6 @@ class Config:
         "sparse_threshold": ("bin storage is dense on TPU; sparse inputs "
                              "are binned without densification but stored "
                              "as dense bin columns"),
-        "use_two_round_loading": ("text ingest here is single-round "
-                                  "in-memory; the flag does not change "
-                                  "loading behavior"),
     }
 
     def __init__(self, params: Optional[Dict[str, Any]] = None,
