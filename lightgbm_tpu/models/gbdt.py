@@ -116,9 +116,10 @@ class GBDT:
                 == self.train_data.num_data + old._row_pad):
             # reuse the uploaded (padded) bin matrix — no host->device
             # transfer on a hyperparameter reset
-            self.learner = SerialTreeLearner(config, self.train_data,
-                                             device_data=old.X,
-                                             device_row_pad=old._row_pad)
+            self.learner = SerialTreeLearner(
+                config, self.train_data, device_data=old.X,
+                device_row_pad=old._row_pad,
+                device_packed_cols=getattr(old, "packed_cols", 0))
         else:
             self.learner = create_tree_learner(config, self.train_data)
         # bagging state (gbdt.cpp ResetBaggingConfig, :134-160)
@@ -245,11 +246,11 @@ class GBDT:
         if tree.has_bin_thresholds:
             ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
             self._score_dev = self._score_dev.at[tid].set(
-                dev_predict.add_tree_to_score(self._score_dev[tid],
-                                              self.learner.X[:self.num_data],
-                                              ta,
-                                              jnp.asarray(scale, self.score_dtype),
-                                              self.learner.bundle_arrays))
+                dev_predict.add_tree_to_score(
+                    self._score_dev[tid], self.learner.X[:self.num_data],
+                    ta, jnp.asarray(scale, self.score_dtype),
+                    self.learner.bundle_arrays,
+                    packed=bool(getattr(self.learner, "packed_cols", 0))))
         elif self.train_data.raw_data is not None:
             s = self.train_score
             s[tid] += scale * tree.predict(self.train_data.raw_data)

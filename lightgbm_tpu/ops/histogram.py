@@ -78,28 +78,46 @@ def _gathered_weights(grad, hess, row_mult, idx, valid):
                      axis=-1)                     # (C, 3)
 
 
-def _scatter_accumulate(binned, w, num_bins: int):
-    """(F, B, 3) from (C, F) bins and (C, 3) weights via segment_sum."""
+def _scatter_accumulate(binned, w, num_bins: int, logical_cols: int = 0):
+    """(F, B, 3) from (C, F) bins and (C, 3) weights via segment_sum.
+
+    logical_cols > 0: binned is 4-bit packed (ops/pack.py split-half
+    layout); nibbles are extracted per column INSIDE the vmap so the
+    full-width matrix never materializes."""
     def per_feature(col):
         return jax.ops.segment_sum(w, col.astype(jnp.int32),
                                    num_segments=num_bins)
-    return jax.vmap(per_feature, in_axes=1)(binned)
+    if not logical_cols:
+        return jax.vmap(per_feature, in_axes=1)(binned)
+    lo = jax.vmap(lambda c: per_feature(c.astype(jnp.int32) & 15),
+                  in_axes=1)(binned)
+    hi = jax.vmap(lambda c: per_feature(c.astype(jnp.int32) >> 4),
+                  in_axes=1)(binned)
+    return jnp.concatenate([lo, hi], axis=0)[:logical_cols]
 
 
-def _onehot_accumulate(binned, w, num_bins: int, chunk: int):
-    """(F, B, 3) via chunked one-hot contraction on the MXU."""
-    n, f = binned.shape
+def _onehot_accumulate(binned, w, num_bins: int, chunk: int,
+                       logical_cols: int = 0):
+    """(F, B, 3) via chunked one-hot contraction on the MXU.
+
+    logical_cols > 0: binned is 4-bit packed (ops/pack.py); chunks unpack
+    in-scan so the full-width matrix never materializes in HBM."""
+    n, fdev = binned.shape
+    f = logical_cols or fdev
     chunk = min(chunk, max(n, 1))
     pad = (-n) % chunk
     if pad:
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         w = jnp.pad(w, ((0, pad), (0, 0)))
     nchunks = (n + pad) // chunk
-    xb = binned.reshape(nchunks, chunk, f)
+    xb = binned.reshape(nchunks, chunk, fdev)
     wb = w.reshape(nchunks, chunk, 3)
 
     def step(acc, args):
         xc, wc = args
+        if logical_cols:
+            from .pack import unpack4
+            xc = unpack4(xc, f)
         onehot = jax.nn.one_hot(xc.astype(jnp.int32), num_bins,
                                 dtype=wc.dtype)          # (C, F, B)
         acc = acc + jnp.einsum("cfb,cw->fbw", onehot, wc,
@@ -128,21 +146,23 @@ def gathered_histogram(X, grad, hess, row_mult, idx, valid, num_bins: int,
     return _scatter_accumulate(Xs, w, num_bins)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins",))
+@functools.partial(jax.jit, static_argnames=("num_bins", "logical_cols"))
 def leaf_histogram_scatter(binned, grad, hess, leaf_id, leaf, row_mult,
-                           num_bins: int):
+                           num_bins: int, logical_cols: int = 0):
     """(F, B, 3) histogram of the target leaf via per-feature segment_sum.
 
     binned: (N, F) uint8/uint16 bin ids; grad/hess: (N,) float;
     leaf_id: (N,) int32; leaf: scalar int; row_mult: (N,) float or None.
     """
     w = _weights(grad, hess, leaf_id, leaf, row_mult)  # (N, 3)
-    return _scatter_accumulate(binned, w, num_bins)    # (F, B, 3)
+    return _scatter_accumulate(binned, w, num_bins, logical_cols)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk", "logical_cols"))
 def leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf, row_mult,
-                          num_bins: int, chunk: int = 16384):
+                          num_bins: int, chunk: int = 16384,
+                          logical_cols: int = 0):
     """(F, B, 3) histogram via chunked one-hot matmul on the MXU.
 
     For each row chunk: one_hot(bins) (C, F, B) contracted with weights
@@ -150,7 +170,7 @@ def leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf, row_mult,
     one-hot tensor never exceeds chunk x F x B.
     """
     w = _weights(grad, hess, leaf_id, leaf, row_mult)  # (N, 3)
-    return _onehot_accumulate(binned, w, num_bins, chunk)
+    return _onehot_accumulate(binned, w, num_bins, chunk, logical_cols)
 
 
 def leaf_histogram(binned, grad, hess, leaf_id, leaf, row_mult,

@@ -96,30 +96,17 @@ def build_split_params(config: Config) -> SplitParams:
 class SerialTreeLearner:
     def __init__(self, config: Config, train_data: TrainingData,
                  psum_axis: Optional[str] = None, device_data=None,
-                 device_row_pad: int = 0):
+                 device_row_pad: int = 0, device_packed_cols: int = 0):
         """device_data: pre-uploaded (and possibly row-padded) bin matrix;
         device_row_pad says how many trailing pad rows it carries so
-        row_mult/_ones stay aligned (reset_config's no-reupload reuse)."""
+        row_mult/_ones stay aligned (reset_config's no-reupload reuse);
+        device_packed_cols: the logical column count when device_data is
+        4-bit packed (0 = unpacked)."""
         self.config = config
         self.train_data = train_data
         self.num_leaves = config.num_leaves
         self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
         self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
-        # round rows up to a quantum so nearby dataset sizes (cv folds,
-        # retrains after appending data) land on the same compiled shape;
-        # padded rows carry zero row_mult and change nothing
-        self._row_pad = device_row_pad
-        if device_data is not None:
-            self.X = device_data
-        else:
-            binned = train_data.binned
-            n = binned.shape[0]
-            self._row_pad = (-n) % 1024
-            if self._row_pad:
-                binned = np.concatenate(
-                    [binned, np.zeros((self._row_pad, binned.shape[1]),
-                                      binned.dtype)])
-            self.X = jnp.asarray(binned)
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(train_data.num_bin_arr),
             default_bin=jnp.asarray(train_data.default_bin_arr),
@@ -144,14 +131,6 @@ class SerialTreeLearner:
         self.cache_hists = hist_cache_enabled(
             config, self.num_leaves, ncols, nbins,
             8 if config.tpu_use_dp else 4)
-        # Ordered-partition growth (grow.py): per-split cost is O(parent
-        # segment) for the partition and O(child segment * F) for the
-        # histogram — the reference's DataPartition + ordered-iteration
-        # economics (data_partition.hpp:94-147, dense_bin.hpp:66-98) — so
-        # the capacity-tier ladder pays at every shape.  Pallas histogram
-        # kernels take the full-N mask form and keep the legacy path.
-        self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
-                               if hist_mode != "pallas" else ())
         # growth schedule: 'wave' batches the top-W pending splits per
         # sweep so the histogram work rides the MXU (ops/wave.py); 'exact'
         # is the per-split leaf-wise order of the reference (ops/grow.py).
@@ -168,6 +147,52 @@ class SerialTreeLearner:
                       and hist_mode != "pallas" else "exact")
         self.growth = growth
         self.wave_width = int(config.tpu_wave_width)
+        # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
+        # every device column fits a nibble, store TWO columns per byte in
+        # HBM; the wave engine unpacks per chunk in-scan, so the bin
+        # matrix's HBM footprint and read traffic halve.  Wave-only (the
+        # TPU default engine); exact/ordered growth and mesh learners keep
+        # byte bins.
+        from .pack import can_pack4
+        bins_per_col = (train_data.bundle.num_group_bins
+                        if train_data.bundle is not None
+                        else train_data.num_bin_arr)
+        from ..utils.config import _TRUE_SET
+        pack_cfg = str(config.tpu_bin_pack).strip().lower()
+        pack_forced = pack_cfg in _TRUE_SET
+        self.packed_cols = 0
+        if ((pack_forced or pack_cfg == "auto") and growth == "wave"
+                and psum_axis is None and can_pack4(bins_per_col)):
+            self.packed_cols = ncols
+        elif pack_forced:
+            Log.warning("tpu_bin_pack=true ignored: needs max_bin<=15 on "
+                        "every column and wave growth")
+        # ---- device upload (row-padded to a quantum so nearby dataset
+        # sizes land on the same compiled shape; pad rows carry zero
+        # row_mult and change nothing)
+        self._row_pad = device_row_pad
+        if device_data is not None and device_packed_cols == self.packed_cols:
+            self.X = device_data
+        else:
+            from .pack import pack4_host
+            binned = train_data.binned
+            n = binned.shape[0]
+            self._row_pad = (-n) % 1024
+            if self._row_pad:
+                binned = np.concatenate(
+                    [binned, np.zeros((self._row_pad, binned.shape[1]),
+                                      binned.dtype)])
+            if self.packed_cols:
+                binned = pack4_host(binned)
+            self.X = jnp.asarray(binned)
+        # Ordered-partition growth (grow.py): per-split cost is O(parent
+        # segment) for the partition and O(child segment * F) for the
+        # histogram — the reference's DataPartition + ordered-iteration
+        # economics (data_partition.hpp:94-147, dense_bin.hpp:66-98) — so
+        # the capacity-tier ladder pays at every shape.  Pallas histogram
+        # kernels take the full-N mask form and keep the legacy path.
+        self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
+                               if hist_mode != "pallas" else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice
         if growth == "wave" and psum_axis is None:
@@ -176,7 +201,7 @@ class SerialTreeLearner:
                 self.num_leaves, self.num_bins, self.params,
                 config.max_depth, self.wave_width, self.dtype, None,
                 self.bundle_arrays is not None, self.group_bins,
-                self.cache_hists, hist_mode, 16384)
+                self.cache_hists, hist_mode, 16384, self.packed_cols)
             meta, bund = self.meta, self.bundle_arrays
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta,

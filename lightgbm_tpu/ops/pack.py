@@ -1,0 +1,48 @@
+"""4-bit bin packing — two bin columns per byte on device.
+
+The reference halves histogram memory traffic for `max_bin<=15` by storing
+two 4-bit bins per byte (src/io/dense_nbits_bin.hpp:37); SURVEY §7 step 8
+names int4 packing as the TPU analog.  Here the packed matrix IS the
+device-resident store: HBM for the bin matrix halves, and the growth
+engines unpack per row-chunk inside their scans (a shift+mask the compiler
+fuses into the chunk's consumers), so the full-size matrix never
+materializes in HBM.
+
+Layout is SPLIT-HALF, not interleaved: packed column ``j`` carries logical
+column ``j`` in its LOW nibble and logical column ``j + Fh`` in its HIGH
+nibble (``Fh = ceil(F/2)``).  Unpacking is then a lane-contiguous
+``concat([x & 15, x >> 4])[:, :F]`` — no strided lane shuffles, which TPU
+vector units (and Mosaic) handle poorly.  With odd ``F`` the last high
+nibble is zero padding and is dropped by the slice.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def can_pack4(num_bins_per_col) -> bool:
+    """True when every device column's bin count fits a nibble."""
+    arr = np.asarray(num_bins_per_col)
+    return arr.size > 0 and int(arr.max()) <= 16
+
+
+def pack4_host(binned: np.ndarray) -> np.ndarray:
+    """(N, F) uint8 bins (< 16) -> (N, ceil(F/2)) packed uint8."""
+    n, f = binned.shape
+    fh = (f + 1) // 2
+    lo = binned[:, :fh].astype(np.uint8)
+    hi = np.zeros((n, fh), dtype=np.uint8)
+    hi[:, : f - fh] = binned[:, fh:]
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def unpack4(xc, logical_cols: int):
+    """Packed (C, Fh) uint8 chunk -> (C, logical_cols) bins.
+
+    Pure bitwise + concat; called inside the growth engines' chunk scans so
+    XLA fuses it into the chunk's one-hot/compare consumers.
+    """
+    x = xc.astype(jnp.int32)
+    return jnp.concatenate([x & 15, x >> 4], axis=-1)[..., :logical_cols]

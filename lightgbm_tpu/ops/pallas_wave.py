@@ -43,7 +43,7 @@ def _bin_pad(num_bins: int) -> int:
 
 
 def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
-                      *, bp, fc, k, bsub):
+                      *, bp, fc, k, bsub, packed):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -53,7 +53,11 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
     # bin ids are exact in f32 and the VPU compares f32 natively (bf16
     # compares are rejected by Mosaic on v5e); only the 0/1 one-hot result
     # is emitted in bf16 for the MXU
-    x = x_ref[:].astype(jnp.int32).astype(jnp.float32)   # (Cg, Fc)
+    xi = x_ref[:]
+    if packed:
+        from .pack import unpack4
+        xi = unpack4(xi, fc)          # lane-contiguous split-half nibbles
+    x = xi.astype(jnp.int32).astype(jnp.float32)         # (Cg, Fc)
     cg = x.shape[0]
 
     # child match + channel-major weights, built in VMEM — nothing
@@ -99,16 +103,21 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
-                                             "interpret"))
+                                             "interpret", "logical_cols"))
 def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
-                          row_tile: int = 8192, interpret: bool = False):
+                          row_tile: int = 8192, interpret: bool = False,
+                          logical_cols: int = 0):
     """(K, F, B, 3) histograms of the rows whose leaf is child_id[k].
 
     X: (N, F) uint8/int bin ids;  leaf_id: (N,) int32 (already partitioned);
     w3: (N, 3) float32 [g, h, mult] per-row channels;
     child_id: (K,) int32 target leaves, -1 entries yield zero histograms.
+    logical_cols > 0: X is 4-bit packed (ops/pack.py split-half layout) and
+    logical_cols is the unpacked column count — the kernel unpacks in VMEM,
+    so the packed matrix is all that crosses HBM.
     """
-    n, fc = X.shape
+    n, fdev = X.shape
+    fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
     # bins per inner sub-block: ~512 lanes per one-hot tile, and a DIVISOR
@@ -132,12 +141,12 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     nch = (n + pad) // c
 
     kernel = functools.partial(_wave_hist_kernel, bp=bp, fc=fc, k=k,
-                               bsub=bsub)
+                               bsub=bsub, packed=bool(logical_cols))
     flat = pl.pallas_call(
         kernel,
         grid=(nch,),
         in_specs=[
-            pl.BlockSpec((c, fc), lambda i: (i, 0),
+            pl.BlockSpec((c, fdev), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((c, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),

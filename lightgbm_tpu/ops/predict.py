@@ -63,16 +63,29 @@ def traversal_from_host_tree(tree, dtype=jnp.float32) -> TraversalArrays:
     )
 
 
-@jax.jit
-def leaf_index_binned(tree: TraversalArrays, X, layout=None):
+@functools.partial(jax.jit, static_argnames=("packed",))
+def leaf_index_binned(tree: TraversalArrays, X, layout=None,
+                      packed: bool = False):
     """Per-row leaf index by iterative descent (Tree::GetLeaf semantics on
     bins); returns zeros for single-leaf trees.
 
     layout: optional ops.grow.BundleArrays when X holds EFB group columns —
     bins are reconstructed per node feature (feature_group.h semantics).
+    packed: X is 4-bit packed in the ops/pack.py split-half layout (logical
+    column j < Fh lives in the low nibble of stored column j, j >= Fh in
+    the high nibble of column j - Fh).
     """
     n = X.shape[0]
     rows = jnp.arange(n)
+    fh = X.shape[1]                      # stored width (packed: ceil(F/2))
+
+    def col_bins(f, nd):
+        """Bin of each row at (possibly packed) device column f."""
+        if not packed:
+            return X[rows, f].astype(jnp.int32)
+        p = jnp.where(f < fh, f, f - fh)
+        raw = X[rows, p].astype(jnp.int32)
+        return jnp.where(f < fh, raw & 15, raw >> 4)
 
     def cond(node):
         return jnp.any(node >= 0)
@@ -81,9 +94,9 @@ def leaf_index_binned(tree: TraversalArrays, X, layout=None):
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
         if layout is None:
-            b = X[rows, f].astype(jnp.int32)
+            b = col_bins(f, nd)
         else:
-            v = X[rows, layout.group_of[f]].astype(jnp.int32)
+            v = col_bins(layout.group_of[f], nd)
             off = layout.bin_off[f]
             in_range = (v >= off) & (v < off + layout.bin_span[f])
             b = jnp.where(in_range, v - off + layout.bin_adj[f],
@@ -104,11 +117,12 @@ def leaf_index_binned(tree: TraversalArrays, X, layout=None):
     return jnp.where(tree.num_leaves > 1, ~node, 0)
 
 
-@jax.jit
-def add_tree_to_score(score, X, tree: TraversalArrays, scale, layout=None):
+@functools.partial(jax.jit, static_argnames=("packed",))
+def add_tree_to_score(score, X, tree: TraversalArrays, scale, layout=None,
+                      packed: bool = False):
     """score += scale * clip(leaf_value)[leaf(X)] — Tree::AddPredictionToScore
     with the Shrinkage clamp (tree.h:110-118) applied at read time."""
-    leaf = leaf_index_binned(tree, X, layout)
+    leaf = leaf_index_binned(tree, X, layout, packed=packed)
     vals = jnp.clip(tree.leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
     add = jnp.where(tree.num_leaves > 1, vals[leaf], 0.0)
     return score + add.astype(score.dtype)

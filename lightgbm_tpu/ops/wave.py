@@ -54,14 +54,15 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       wave_width: int = 16, hist_dtype=jnp.float32,
                       psum_axis: str = None, bundle=None,
                       group_bins: int = 0, cache_hists: bool = True,
-                      hist_mode: str = "onehot", chunk: int = 16384):
+                      hist_mode: str = "onehot", chunk: int = 16384,
+                      packed_cols: int = 0):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id))."""
     core = make_wave_core(num_leaves, num_bins, params, max_depth,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
-                          hist_mode, chunk)
+                          hist_mode, chunk, packed_cols)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -82,7 +83,12 @@ def make_wave_jit(*static_args):
 def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    max_depth: int, wave_width: int, hist_dtype,
                    psum_axis: str, has_bundle: bool, group_bins: int,
-                   cache_hists: bool, hist_mode: str, chunk: int):
+                   cache_hists: bool, hist_mode: str, chunk: int,
+                   packed_cols: int = 0):
+    """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
+    byte) and packed_cols is the LOGICAL column count; every chunk is
+    unpacked in-scan so the full-width matrix never hits HBM (the
+    dense_nbits_bin.hpp:37 bandwidth halving, TPU form)."""
     L = num_leaves
     W = max(1, min(wave_width, L - 1))
     hist_bins = group_bins if has_bundle else num_bins
@@ -114,7 +120,13 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
     def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
         n = X.shape[0]
-        Fc = X.shape[1]                   # group columns on device
+        Fc = packed_cols or X.shape[1]    # LOGICAL group columns
+        Fdev = X.shape[1]                 # stored columns (packed: half)
+        if packed_cols:
+            from .pack import unpack4
+            unpack = lambda xc: unpack4(xc, Fc)  # noqa: E731
+        else:
+            unpack = lambda xc: xc               # noqa: E731
         grad = grad.astype(hist_dtype)
         hess = hess.astype(hist_dtype)
         row_mult = row_mult.astype(hist_dtype)
@@ -128,7 +140,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         pad = (-n) % c
         nch = (n + pad) // c
         Xp = jnp.pad(X, ((0, pad), (0, 0))) if pad else X
-        xb = Xp.reshape(nch, c, Fc)
+        xb = Xp.reshape(nch, c, Fdev)
 
         def wave_pass(leaf_id, tbl, small_id, valid):
             """Partition + child histograms, fused into ONE chunked sweep.
@@ -152,7 +164,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             f_iota = jnp.arange(Fc, dtype=jnp.int32)
 
             def step(acc, args):
-                xc, lc, wc = args                   # (C,Fc) (C,) (C,3)
+                xc, lc, wc = args                   # (C,Fdev) (C,) (C,3)
+                xc = unpack(xc)                     # (C, Fc) logical bins
                 leaf_oh = (lc[:, None] == l_iota[None, :]).astype(
                     jnp.float32)                    # (C, L)
                 # HIGHEST: TPU's default matmul precision is bf16, which
@@ -203,7 +216,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 from .pallas_wave import wave_histogram_pallas
                 cid = jnp.where(valid, small_id, -1)
                 hist = wave_histogram_pallas(X, new_leaf_id, w3, cid,
-                                             hist_bins)
+                                             hist_bins,
+                                             logical_cols=packed_cols)
             else:
                 # (Fc*B, W*3) -> (W, Fc, B, 3)
                 hist = flat.reshape(Fc, hist_bins, W, 3).transpose(2, 0, 1,
@@ -216,7 +230,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             if use_pallas_hist:
                 from .pallas_wave import wave_histogram_pallas
                 return wave_histogram_pallas(
-                    X, leaf_id, w3, jnp.where(valid, ids, -1), hist_bins)
+                    X, leaf_id, w3, jnp.where(valid, ids, -1), hist_bins,
+                    logical_cols=packed_cols)
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
                 else leaf_id.reshape(nch, c)
             wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3
@@ -224,6 +239,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
             def step(acc, args):
                 xc, lc, wc = args
+                xc = unpack(xc)
                 match = ((lc[:, None] == ids[None, :])
                          & valid[None, :]).astype(hist_dtype)
                 wmat = (match[:, :, None] * wc[:, None, :]).reshape(c, 3 * W)
@@ -257,7 +273,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         # ---- root
         root_sums = maybe_psum(jnp.sum(w3, axis=0))
         hist0 = maybe_psum(root_hist_fn(X, grad, hess, leaf_id, 0, row_mult,
-                                        num_bins=hist_bins))
+                                        num_bins=hist_bins,
+                                        logical_cols=packed_cols))
         Fh, B = hist0.shape[0], hist0.shape[1]
         if cache_hists:
             hists = jnp.zeros((L, Fh, B, 3), hist_dtype).at[0].set(hist0)
