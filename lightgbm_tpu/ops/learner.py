@@ -157,16 +157,29 @@ class SerialTreeLearner:
         bins_per_col = (train_data.bundle.num_group_bins
                         if train_data.bundle is not None
                         else train_data.num_bin_arr)
-        from ..utils.config import _TRUE_SET
+        from ..utils.config import _FALSE_SET, _TRUE_SET
         pack_cfg = str(config.tpu_bin_pack).strip().lower()
+        if pack_cfg not in _TRUE_SET | _FALSE_SET | {"auto"}:
+            Log.fatal("tpu_bin_pack: value %s cannot be parsed as "
+                      "auto/bool", config.tpu_bin_pack)
         pack_forced = pack_cfg in _TRUE_SET
         self.packed_cols = 0
         if ((pack_forced or pack_cfg == "auto") and growth == "wave"
                 and psum_axis is None and can_pack4(bins_per_col)):
             self.packed_cols = ncols
         elif pack_forced:
-            Log.warning("tpu_bin_pack=true ignored: needs max_bin<=15 on "
-                        "every column and wave growth")
+            reasons = []
+            if growth != "wave":
+                reasons.append("tpu_growth=wave")
+            if psum_axis is not None:
+                reasons.append("the serial (single-shard) learner")
+            if not can_pack4(bins_per_col):
+                reasons.append("max_bin<=15 on every column")
+            Log.warning("tpu_bin_pack=true ignored: packing requires %s",
+                        " and ".join(reasons))
+        if int(config.tpu_wave_chunk) <= 0:
+            Log.fatal("tpu_wave_chunk must be positive, got %s",
+                      config.tpu_wave_chunk)
         # ---- device upload (row-padded to a quantum so nearby dataset
         # sizes land on the same compiled shape; pad rows carry zero
         # row_mult and change nothing)
@@ -201,7 +214,8 @@ class SerialTreeLearner:
                 self.num_leaves, self.num_bins, self.params,
                 config.max_depth, self.wave_width, self.dtype, None,
                 self.bundle_arrays is not None, self.group_bins,
-                self.cache_hists, hist_mode, 16384, self.packed_cols)
+                self.cache_hists, hist_mode,
+                int(config.tpu_wave_chunk), self.packed_cols)
             meta, bund = self.meta, self.bundle_arrays
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta,

@@ -42,6 +42,34 @@ def _bin_pad(num_bins: int) -> int:
     return ((num_bins + 127) // 128) * 128
 
 
+def _split_weights(lid_ref, w3_ref, cid_ref):
+    """Per-child masked weight channels, split into exact bf16 hi + scaled
+    bf16 residual for f32-quality MXU accumulation.
+
+    match (Cg, K) x channels (Cg, 3) -> (Cg, 3K), then an exact hi/lo split
+    by mantissa truncation — a bf16 round-trip would be folded to identity
+    under --xla_allow_excess_precision, silently zeroing the residual term
+    (observed on v5e).  The residual is scaled by 2^8 (exact) into bf16
+    range; Mosaic's f32->bf16 cast TRUNCATES (measured: biased sums ~100x
+    above round-to-nearest theory), so it is rounded manually in bit
+    arithmetic first — after that the cast drops only zero bits.  Shared by
+    both kernel layouts so the precision workarounds cannot diverge.
+    """
+    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
+    w3 = w3_ref[:]                                           # (Cg, 3)
+    wmat = jnp.concatenate(
+        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
+    wh_f32 = pltpu.bitcast(
+        pltpu.bitcast(wmat, jnp.uint32) & jnp.uint32(0xFFFF0000),
+        jnp.float32)
+    wh = wh_f32.astype(jnp.bfloat16)                 # exact: mantissa fits
+    wl_f32 = (wmat - wh_f32) * jnp.float32(256.0)
+    wl = pltpu.bitcast(
+        (pltpu.bitcast(wl_f32, jnp.uint32) + jnp.uint32(0x8000))
+        & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
+    return wh, wl
+
+
 def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
                       *, bp, fc, k, bsub, packed):
     i = pl.program_id(0)
@@ -62,25 +90,7 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
     # child match + channel-major weights, built in VMEM — nothing
     # per-wave crosses HBM beyond X/leaf_id/w3 themselves
-    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
-    w3 = w3_ref[:]                                           # (Cg, 3)
-    wmat = jnp.concatenate(
-        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
-    # exact hi/lo split by mantissa truncation — a bf16 round-trip would be
-    # folded to identity under --xla_allow_excess_precision, silently
-    # zeroing the residual term (observed on v5e)
-    wh_f32 = pltpu.bitcast(
-        pltpu.bitcast(wmat, jnp.uint32) & jnp.uint32(0xFFFF0000),
-        jnp.float32)
-    wh = wh_f32.astype(jnp.bfloat16)                 # exact: mantissa fits
-    # residual, scaled by 2^8 (exact) into bf16 range.  Mosaic's f32->bf16
-    # cast TRUNCATES (measured: biased sums ~100x above round-to-nearest
-    # theory), so round manually in bit arithmetic first — after that the
-    # cast drops only zero bits.
-    wl_f32 = (wmat - wh_f32) * jnp.float32(256.0)
-    wl = pltpu.bitcast(
-        (pltpu.bitcast(wl_f32, jnp.uint32) + jnp.uint32(0x8000))
-        & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
+    wh, wl = _split_weights(lid_ref, w3_ref, cid_ref)
 
     xr = pltpu.repeat(x, bsub, axis=1)                   # (Cg, bsub*Fc)
     lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
@@ -172,3 +182,98 @@ def wave_histogram_reference(X, leaf_id, w3, child_id, num_bins: int):
     match = (leaf_id[:, None] == child_id[None, :]).astype(jnp.float32)
     oh = jax.nn.one_hot(X.astype(jnp.int32), num_bins, dtype=jnp.float32)
     return jnp.einsum("nfb,nk,nc->kfbc", oh, match, w3)
+
+
+# --------------------------------------------------------------------------
+# v2: transposed operand layout.  The v1 kernel's dot contracts dim 0 of
+# BOTH operands (oh (Cg, Q)^T @ w (Cg, 3K)) — the MXU's non-native
+# orientation, which Mosaic may realize via an in-VMEM transpose of the
+# 15MB one-hot tile.  Here the one-hot is GENERATED already transposed,
+# (Q, Cg), from a transposed bin matrix X_t (F, N): the dot is then the
+# native (M, K) @ (K, N) form with no transpose anywhere.  The partition
+# scan keeps the row-major X; X_t is a one-time device-side copy.
+# --------------------------------------------------------------------------
+
+def _wave_hist_kernel_t(xt_ref, lid_ref, w3_ref, cid_ref, out_ref,
+                        *, bp, fc, k, bsub, packed):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xi = xt_ref[:].astype(jnp.int32)                 # (Fdev, Cg)
+    if packed:
+        # split-half nibble unpack along SUBLANES (ops/pack.py layout)
+        xi = jnp.concatenate([xi & 15, xi >> 4], axis=0)[:fc]
+    xt = xi.astype(jnp.float32)                      # (Fc, Cg)
+    cg = xt.shape[1]
+
+    wh, wl = _split_weights(lid_ref, w3_ref, cid_ref)    # (Cg, 3K) hi/lo
+
+    xr = pltpu.repeat(xt, bsub, axis=0)              # (bsub*Fc, Cg) tiled
+    base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
+            // fc).astype(jnp.float32)               # bin-within-subblock
+    for s in range(bp // bsub):
+        oh = jnp.where(xr == base + jnp.float32(s * bsub),
+                       jnp.float32(1.0),
+                       jnp.float32(0.0)).astype(jnp.bfloat16)  # (Q, Cg)
+        acc = jax.lax.dot_general(
+            oh, wh, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (bsub*Fc, 3K)
+        acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
+            oh, wl, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = slice(s * bsub * fc, (s + 1) * bsub * fc)
+        out_ref[rows, :] = out_ref[rows, :] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
+                                             "interpret", "logical_cols"))
+def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
+                            row_tile: int = 8192, interpret: bool = False,
+                            logical_cols: int = 0):
+    """Same contract as wave_histogram_pallas, but takes the TRANSPOSED bin
+    matrix X_t (F, N) (packed: (ceil(F/2), N) with logical_cols set)."""
+    fdev, n = X_t.shape
+    fc = logical_cols or fdev
+    k = child_id.shape[0]
+    bp = _bin_pad(num_bins)
+    bsub = 1
+    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
+        bsub *= 2
+    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
+    c = min(c, max(n, 1))
+    pad = (-n) % c
+    lid2 = leaf_id[:, None]
+    w3f = w3.astype(jnp.float32)
+    if pad:
+        X_t = jnp.pad(X_t, ((0, 0), (0, pad)))
+        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
+        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
+    nch = (n + pad) // c
+
+    kernel = functools.partial(_wave_hist_kernel_t, bp=bp, fc=fc, k=k,
+                               bsub=bsub, packed=bool(logical_cols))
+    flat = pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[
+            pl.BlockSpec((fdev, c), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 3), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(X_t, lid2, w3f, child_id[None, :])
+    h = flat.reshape(bp, fc, 3, k)[:num_bins]
+    return jnp.transpose(h, (3, 1, 0, 2))

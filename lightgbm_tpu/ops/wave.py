@@ -91,6 +91,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     dense_nbits_bin.hpp:37 bandwidth halving, TPU form)."""
     L = num_leaves
     W = max(1, min(wave_width, L - 1))
+    chunk = max(int(chunk), 256)      # guard tpu_wave_chunk<=0 etc.
     hist_bins = group_bins if has_bundle else num_bins
     # the bin one-hot holds only 0/1 — exact in bf16 — and is the dominant
     # HBM traffic of the wave pass; on TPU the MXU also multiplies bf16
@@ -272,9 +273,11 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
         # ---- root
         root_sums = maybe_psum(jnp.sum(w3, axis=0))
+        root_kw = ({"chunk": chunk} if root_hist_fn is leaf_histogram_onehot
+                   else {})
         hist0 = maybe_psum(root_hist_fn(X, grad, hess, leaf_id, 0, row_mult,
                                         num_bins=hist_bins,
-                                        logical_cols=packed_cols))
+                                        logical_cols=packed_cols, **root_kw))
         Fh, B = hist0.shape[0], hist0.shape[1]
         if cache_hists:
             hists = jnp.zeros((L, Fh, B, 3), hist_dtype).at[0].set(hist0)

@@ -156,7 +156,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 config.max_depth, wave_width=self.wave_width,
                 hist_dtype=self.dtype, psum_axis=DATA_AXIS,
                 bundle=self.bundle_arrays, group_bins=self.group_bins,
-                cache_hists=self.cache_hists, hist_mode=self.hist_mode)
+                cache_hists=self.cache_hists, hist_mode=self.hist_mode,
+                chunk=int(config.tpu_wave_chunk))
         else:
             grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                                 self.params, config.max_depth,

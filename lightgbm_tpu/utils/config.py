@@ -131,7 +131,7 @@ PARAMETER_SET = {
     "capacity",
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
-    "tpu_growth", "tpu_wave_width", "tpu_bin_pack",
+    "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -329,6 +329,10 @@ class Config:
         # frontier, batched; quality parity in tests/test_wave.py); set 1
         # to reproduce the reference's exact split sequence.
         "tpu_wave_width": ("int", 16),
+        # row-chunk size of the wave engine's fused partition+histogram
+        # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
+        # (VMEM-residency vs scan-overhead tradeoff on TPU)
+        "tpu_wave_chunk": ("int", 16384),
         # 'auto' | 'true' | 'false' — 4-bit bin packing (ops/pack.py, the
         # dense_nbits_bin.hpp:37 analog): when every device column fits a
         # nibble (max_bin<=15), two columns share a byte in HBM and the

@@ -1,7 +1,10 @@
-"""Microbench: wave_histogram_pallas vs the XLA one-hot contraction.
+"""Microbench: wave-histogram kernels + XLA variants on the live backend.
 
-Times ONLY the histogram op (K children) and the partition-style scan,
-to locate where the end-to-end pallas-mode regression comes from.
+Times the histogram op (K children) both ways (pallas v1 row-major,
+pallas v2 transposed), the XLA one-hot scan at several chunk sizes, and
+the partition-style scan.  Each timing forces a host readback (axon's
+block_until_ready is unreliable) and subtracts the measured null
+round-trip latency.
 """
 import os
 import sys
@@ -15,96 +18,88 @@ import jax
 import jax.numpy as jnp
 
 
-def timeit(fn, *args, reps=10, vary=None):
-    """vary: index of an f32 arg to scale per-rep (defeats the axon
-    tunnel's identical-dispatch dedup)."""
+def force(o):
+    leaves = jax.tree_util.tree_leaves(o)
+    return float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:8]))
+
+
+def timeit(fn, *args, reps=8, vary=None, rt=0.0):
+    """Per-call force timing minus the null round-trip rt.  vary: index of
+    an f32 arg scaled per rep (defeats the tunnel's dispatch dedup)."""
+    scales = [jnp.float32(1.0 + 0.001 * i) for i in range(reps + 1)]
+
     def call(i):
         a = list(args)
         if vary is not None:
-            a[vary] = a[vary] * (1.0 + 0.001 * i)
+            a[vary] = a[vary] * scales[i]
         return fn(*a)
 
-    def force(o):
-        # axon block_until_ready is unreliable; pull one scalar to host
-        leaves = jax.tree_util.tree_leaves(o)
-        return float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:8]))
-
-    def chain(k):
-        # k reps chained by a data dependency (each rep's vary-arg is
-        # perturbed by the previous output), ONE readback at the end —
-        # amortizes the tunnel round-trip out of the per-rep time
-        # all per-rep scalars live on device: a fresh Python constant would
-        # trigger a fresh eager compile (seconds each over the tunnel)
-        eps = jnp.float32(0.0)
-        one = jnp.float32(1.0)
-        tiny = jnp.float32(1e-6)
-        nano = jnp.float32(1e-9)
-        step = jnp.float32(0.001)
-        i_dev = jnp.float32(1.0)
-        for i in range(k):
-            a = list(args)
-            if vary is not None:
-                a[vary] = a[vary] * (one + tiny * eps + step * i_dev)
-            o = fn(*a)
-            lv = jax.tree_util.tree_leaves(o)[0]
-            eps = jnp.sum(lv.astype(jnp.float32).ravel()[:8]) * nano
-            i_dev = i_dev + one
-        return float(eps)
-
-    chain(1)
+    force(call(0))
     t0 = time.time()
-    chain(1)
-    t1 = time.time()
-    chain(1 + reps)
-    t2 = time.time()
-    return ((t2 - t1) - (t1 - t0)) / reps
+    for i in range(reps):
+        force(call(i + 1))
+    return (time.time() - t0) / reps - rt
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 999424
     fc, b, k = 28, 63, 32
     rng = np.random.default_rng(0)
-    X = jnp.asarray(rng.integers(0, b, size=(n, fc), dtype=np.uint8))
+    Xh = rng.integers(0, b, size=(n, fc), dtype=np.uint8)
+    X = jnp.asarray(Xh)
+    Xt = jnp.asarray(np.ascontiguousarray(Xh.T))
     leaf_id = jnp.asarray(rng.integers(0, 255, size=n, dtype=np.int32))
     w3 = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
     cid = jnp.asarray(np.arange(k, dtype=np.int32))
 
-    from lightgbm_tpu.ops.pallas_wave import wave_histogram_pallas
+    # null round-trip: same force pattern on a trivial varying op
+    z = jnp.ones((8, 8), jnp.float32)
+    rt = timeit(jax.jit(lambda a: a * 2.0), z, vary=0)
+    print("null round-trip: %.2f ms" % (rt * 1e3), flush=True)
 
-    t = timeit(jax.jit(lambda *a: wave_histogram_pallas(*a, num_bins=b)),
-               X, leaf_id, w3, cid, vary=2)
-    print("pallas kernel: %.2f ms" % (t * 1e3), flush=True)
+    from lightgbm_tpu.ops.pallas_wave import (wave_histogram_pallas,
+                                              wave_histogram_pallas_t)
 
-    # XLA equivalent: chunked scan, one-hot einsum (the wave_pass hist half)
+    t = timeit(jax.jit(lambda x, l, w, c: wave_histogram_pallas(
+        x, l, w, c, num_bins=b)), X, leaf_id, w3, cid, vary=2, rt=rt)
+    print("pallas v1 (row-major): %.2f ms" % (t * 1e3), flush=True)
+
+    t = timeit(jax.jit(lambda x, l, w, c: wave_histogram_pallas_t(
+        x, l, w, c, num_bins=b)), Xt, leaf_id, w3, cid, vary=2, rt=rt)
+    print("pallas v2 (transposed): %.2f ms" % (t * 1e3), flush=True)
+
+    for chunk in (2048, 4096, 8192, 16384, 32768):
+        if n % chunk:
+            continue
+        nch = n // chunk
+
+        def xla_hist(X, leaf_id, w3, cid, _c=chunk, _nch=nch):
+            xb = X.reshape(_nch, _c, fc)
+            lb = leaf_id.reshape(_nch, _c)
+            wb = w3.reshape(_nch, _c, 3)
+
+            def step(acc, args):
+                xc, lc, wc = args
+                match = (lc[:, None] == cid[None, :]).astype(jnp.float32)
+                wmat = (match[:, :, None] * wc[:, None, :]).reshape(_c, 3 * k)
+                oh = jax.nn.one_hot(xc.astype(jnp.int32), b,
+                                    dtype=jnp.bfloat16)
+                return acc + jnp.einsum(
+                    "cq,cw->qw", oh.reshape(_c, fc * b), wmat,
+                    preferred_element_type=jnp.float32), None
+
+            acc, _ = jax.lax.scan(
+                step, jnp.zeros((fc * b, 3 * k), jnp.float32), (xb, lb, wb))
+            return acc
+
+        t = timeit(jax.jit(xla_hist), X, leaf_id, w3, cid, vary=2, rt=rt)
+        print("xla scan hist chunk=%5d: %.2f ms" % (chunk, t * 1e3),
+              flush=True)
+
+    tbl = jnp.asarray(rng.normal(size=(255, 10)).astype(np.float32))
     chunk = 16384
     nch = n // chunk
 
-    @jax.jit
-    def xla_hist(X, leaf_id, w3, cid):
-        xb = X.reshape(nch, chunk, fc)
-        lb = leaf_id.reshape(nch, chunk)
-        wb = w3.reshape(nch, chunk, 3)
-
-        def step(acc, args):
-            xc, lc, wc = args
-            match = (lc[:, None] == cid[None, :]).astype(jnp.float32)
-            wmat = (match[:, :, None] * wc[:, None, :]).reshape(chunk, 3 * k)
-            oh = jax.nn.one_hot(xc.astype(jnp.int32), b, dtype=jnp.bfloat16)
-            return acc + jnp.einsum(
-                "cq,cw->qw", oh.reshape(chunk, fc * b), wmat,
-                preferred_element_type=jnp.float32), None
-
-        acc, _ = jax.lax.scan(step, jnp.zeros((fc * b, 3 * k), jnp.float32),
-                              (xb, lb, wb))
-        return acc
-
-    t = timeit(xla_hist, X, leaf_id, w3, cid, vary=2)
-    print("xla scan hist: %.2f ms" % (t * 1e3), flush=True)
-
-    # partition-only scan (the non-hist half of wave_pass in pallas mode)
-    tbl = jnp.asarray(rng.normal(size=(255, 10)).astype(np.float32))
-
-    @jax.jit
     def part_scan(X, leaf_id, tbl):
         xb = X.reshape(nch, chunk, fc)
         lb = leaf_id.reshape(nch, chunk)
@@ -114,8 +109,7 @@ def main():
         def step(_, args):
             xc, lc = args
             leaf_oh = (lc[:, None] == l_iota[None, :]).astype(jnp.float32)
-            r = jnp.matmul(leaf_oh, tbl,
-                           precision=jax.lax.Precision.HIGHEST)
+            r = jnp.matmul(leaf_oh, tbl, precision=jax.lax.Precision.HIGHEST)
             cj = r[:, 1].astype(jnp.int32)
             colv = jnp.sum(jnp.where(cj[:, None] == f_iota[None, :], xc, 0)
                            .astype(jnp.int32), axis=1)
@@ -126,8 +120,9 @@ def main():
         _, lid = jax.lax.scan(step, 0, (xb, lb))
         return lid
 
-    t = timeit(part_scan, X, leaf_id, tbl, vary=2)
-    print("partition scan: %.2f ms" % (t * 1e3), flush=True)
+    if n % chunk == 0:
+        t = timeit(jax.jit(part_scan), X, leaf_id, tbl, vary=2, rt=rt)
+        print("partition scan chunk=16384: %.2f ms" % (t * 1e3), flush=True)
 
 
 if __name__ == "__main__":
