@@ -114,6 +114,10 @@ class SerialTreeLearner:
         )
         self.params = build_split_params(config)
         hist_mode = config.tpu_histogram_mode
+        if hist_mode not in ("auto", "onehot", "scatter", "pallas",
+                             "pallas_t"):
+            Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
+                      "scatter/pallas/pallas_t)", hist_mode)
         if hist_mode == "auto":
             # measured on v5e (1M x 28, varying inputs to defeat dispatch
             # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
@@ -143,8 +147,16 @@ class SerialTreeLearner:
             Log.fatal("Unknown tpu_growth %s (expected auto/exact/wave)",
                       growth)
         if growth == "auto":
-            growth = ("wave" if jax.default_backend() == "tpu"
-                      and hist_mode != "pallas" else "exact")
+            # 'pallas' is the exact engine's per-leaf kernel; 'pallas_t'
+            # exists only as a wave kernel
+            if hist_mode == "pallas_t":
+                growth = "wave"
+            else:
+                growth = ("wave" if jax.default_backend() == "tpu"
+                          and hist_mode != "pallas" else "exact")
+        if growth == "exact" and hist_mode == "pallas_t":
+            Log.fatal("tpu_histogram_mode=pallas_t requires tpu_growth=wave "
+                      "(the transposed kernel is wave-only)")
         self.growth = growth
         self.wave_width = int(config.tpu_wave_width)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
@@ -205,7 +217,8 @@ class SerialTreeLearner:
         # the capacity-tier ladder pays at every shape.  Pallas histogram
         # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
-                               if hist_mode != "pallas" else ())
+                               if not hist_mode.startswith("pallas")
+                               else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice
         if growth == "wave" and psum_axis is None:
@@ -217,10 +230,15 @@ class SerialTreeLearner:
                 self.cache_hists, hist_mode,
                 int(config.tpu_wave_chunk), self.packed_cols)
             meta, bund = self.meta, self.bundle_arrays
+            # the transposed kernel's (F, N) matrix: materialized ONCE per
+            # booster (X never changes across trees), not per dispatch
+            xt = (jnp.transpose(self.X)
+                  if hist_mode == "pallas_t"
+                  and jax.default_backend() == "tpu" else None)
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta,
-                      _bund=bund):
-                return _core(X, g, h, rm, m, _meta, _bund)
+                      _bund=bund, _xt=xt):
+                return _core(X, g, h, rm, m, _meta, _bund, Xt=_xt)
 
             self._grow = _grow
         elif psum_axis is None:

@@ -99,14 +99,15 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     oh_dtype = (jnp.bfloat16
                 if jax.default_backend() == "tpu"
                 and hist_dtype == jnp.float32 else hist_dtype)
-    # fused Pallas kernel (ops/pallas_wave.py): generates the one-hot in
+    # fused Pallas kernels (ops/pallas_wave.py): generate the one-hot in
     # VMEM instead of materializing (chunk, F*B) blocks through HBM.
-    # Opt-in (hist_mode='pallas') while its precision work is validated:
-    # Mosaic's f32->bf16 cast truncates, and the resulting histogram bias
-    # measurably costs AUC without the manual-rounding fix.
+    # Opt-in (hist_mode='pallas' row-major / 'pallas_t' transposed) while
+    # their end-to-end win is validated; precision is handled by the bf16
+    # hi/lo weight split (manual rounding — Mosaic's cast truncates).
     use_pallas_hist = (jax.default_backend() == "tpu"
                        and hist_dtype == jnp.float32
-                       and hist_mode == "pallas")
+                       and hist_mode in ("pallas", "pallas_t"))
+    pallas_transposed = hist_mode == "pallas_t"
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -116,10 +117,14 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     def to_feature_hist(ghist, sums, meta, bundle):
         return feature_hist_view(ghist, sums, meta, bundle, has_bundle)
 
-    root_hist_fn = (leaf_histogram_onehot if hist_mode == "onehot"
-                    else leaf_histogram_scatter)
+    # scatter-add serializes on TPU (~226ms vs onehot's 7.2ms at 1Mx28,
+    # B=63) — only the explicit 'scatter' mode should pay it; the pallas
+    # modes keep the fast one-hot root (once per tree, before the kernel
+    # takes over the per-wave work)
+    root_hist_fn = (leaf_histogram_scatter if hist_mode == "scatter"
+                    else leaf_histogram_onehot)
 
-    def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
+    def grow(X, grad, hess, row_mult, feature_mask, meta, bundle, Xt=None):
         n = X.shape[0]
         Fc = packed_cols or X.shape[1]    # LOGICAL group columns
         Fdev = X.shape[1]                 # stored columns (packed: half)
@@ -142,6 +147,23 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         nch = (n + pad) // c
         Xp = jnp.pad(X, ((0, pad), (0, 0))) if pad else X
         xb = Xp.reshape(nch, c, Fdev)
+        # transposed matrix for the v2 kernel (MXU-native dot orientation):
+        # callers that hold X for many trees pass a precomputed Xt (the
+        # learner materializes it once per booster); otherwise fall back to
+        # one (F, N) materialization per tree dispatch
+        if use_pallas_hist and pallas_transposed and Xt is None:
+            Xt = jnp.transpose(X)
+
+        def pallas_hist(lid, cid):
+            """Dispatch to the fused kernel in the configured layout —
+            the single call site for both wave_pass and rehist."""
+            if pallas_transposed:
+                from .pallas_wave import wave_histogram_pallas_t
+                return wave_histogram_pallas_t(Xt, lid, w3, cid, hist_bins,
+                                               logical_cols=packed_cols)
+            from .pallas_wave import wave_histogram_pallas
+            return wave_histogram_pallas(X, lid, w3, cid, hist_bins,
+                                         logical_cols=packed_cols)
 
         def wave_pass(leaf_id, tbl, small_id, valid):
             """Partition + child histograms, fused into ONE chunked sweep.
@@ -214,11 +236,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 flat, lid2 = lax.scan(step, init, (xb, lb, wb3))
                 new_leaf_id = lid2.reshape(-1)[:n]
             if use_pallas_hist:
-                from .pallas_wave import wave_histogram_pallas
-                cid = jnp.where(valid, small_id, -1)
-                hist = wave_histogram_pallas(X, new_leaf_id, w3, cid,
-                                             hist_bins,
-                                             logical_cols=packed_cols)
+                hist = pallas_hist(new_leaf_id,
+                                   jnp.where(valid, small_id, -1))
             else:
                 # (Fc*B, W*3) -> (W, Fc, B, 3)
                 hist = flat.reshape(Fc, hist_bins, W, 3).transpose(2, 0, 1,
@@ -229,10 +248,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             """Histograms of `ids` children only (no partition) — the
             no-cache larger-child pass."""
             if use_pallas_hist:
-                from .pallas_wave import wave_histogram_pallas
-                return wave_histogram_pallas(
-                    X, leaf_id, w3, jnp.where(valid, ids, -1), hist_bins,
-                    logical_cols=packed_cols)
+                return pallas_hist(leaf_id, jnp.where(valid, ids, -1))
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
                 else leaf_id.reshape(nch, c)
             wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3

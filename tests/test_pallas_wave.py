@@ -40,6 +40,29 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
+def test_pallas_t_mode_plumbing():
+    """tpu_histogram_mode=pallas_t resolves to wave growth and trains
+    (falling back to the einsum path off-TPU); exact growth rejects it."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1200, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "tpu_histogram_mode": "pallas_t"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=3)
+    assert bst._gbdt.learner.growth == "wave"
+    p = bst.predict(X)
+    assert p.shape == (1200,)
+
+    bad = dict(params, tpu_growth="exact")
+    with pytest.raises(LightGBMError):
+        lgb.train(bad, lgb.Dataset(X, label=y, params=bad),
+                  num_boost_round=1)
+
+
 @pytest.mark.parametrize("layout", ["v1", "v2"])
 def test_kernel_packed_matches_oracle(layout):
     X, leaf_id, w3, cid, b = _data(f=9, b=15, seed=3)
