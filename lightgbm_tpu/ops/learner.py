@@ -232,9 +232,12 @@ class SerialTreeLearner:
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch
+            # mirror make_wave_core's use_pallas_hist gate (TPU + f32) so
+            # no dead (F, N) copy is pinned when the kernel won't run
             xt = (jnp.transpose(self.X)
                   if hist_mode == "pallas_t"
-                  and jax.default_backend() == "tpu" else None)
+                  and jax.default_backend() == "tpu"
+                  and self.dtype == jnp.float32 else None)
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta,
                       _bund=bund, _xt=xt):
@@ -259,9 +262,14 @@ class SerialTreeLearner:
 
             self._grow = _grow
         else:
+            # the distributed base fallback is the exact engine; the
+            # wave-only pallas_t kernel maps to onehot here — mesh
+            # subclasses that run the wave schedule install their own
+            # pallas_t-capable grow right after this constructor
+            base_mode = "onehot" if hist_mode == "pallas_t" else hist_mode
             self._grow = make_grow_fn(self.num_leaves, self.num_bins,
                                       self.meta, self.params,
-                                      config.max_depth, hist_mode=hist_mode,
+                                      config.max_depth, hist_mode=base_mode,
                                       hist_dtype=self.dtype,
                                       psum_axis=psum_axis,
                                       bundle=self.bundle_arrays,
