@@ -151,6 +151,9 @@ def LGBM_DatasetSaveBinary(handle: int, filename: str) -> int:
 
 def LGBM_DatasetFree(handle: int) -> int:
     _handles.pop(handle, None)
+    # drop pinned GetField pointers for this dataset (C ABI bridge)
+    for key in [k for k in _field_cache if k[0] == handle]:
+        _field_cache.pop(key, None)
     return 0
 
 
@@ -570,3 +573,89 @@ def _abi_booster_predict_csr(handle, mv_indptr, n_indptr, indptr_code,
 
 def _abi_booster_save_model_string(handle, num_iteration):
     return LGBM_BoosterSaveModelToString(handle, num_iteration)
+
+
+def _abi_booster_dump_model(handle, num_iteration):
+    return LGBM_BoosterDumpModel(handle, num_iteration)
+
+
+def _abi_dataset_push_rows(handle, mv, nrow, ncol, dtype_code, start_row):
+    mat = _np_from_buffer(mv, nrow * ncol, dtype_code).reshape(nrow, ncol)
+    return LGBM_DatasetPushRows(handle, mat, start_row)
+
+
+def _abi_dataset_push_rows_csr(handle, mv_indptr, n_indptr, indptr_code,
+                               mv_indices, mv_data, nnz, data_code,
+                               num_col, start_row):
+    indptr = _np_from_buffer(mv_indptr, n_indptr, indptr_code)
+    indices = _np_from_buffer(mv_indices, nnz, 2)
+    data = _np_from_buffer(mv_data, nnz, data_code)
+    return LGBM_DatasetPushRowsByCSR(handle, indptr, indices, data,
+                                     num_col, start_row)
+
+
+def _abi_dataset_from_sampled(cols, idxs, num_col, num_sample_row,
+                              num_total_row, parameters):
+    """cols/idxs: per-column memoryviews (f64 values / i32 row indices),
+    sized by the C caller from num_per_col."""
+    sd = [np.frombuffer(c, dtype=np.float64).copy() for c in cols]
+    si = [np.frombuffer(i, dtype=np.int32).copy() for i in idxs]
+    return LGBM_DatasetCreateFromSampledColumn(
+        sd, si, num_col, [len(x) for x in sd], num_sample_row,
+        num_total_row, parameters)
+
+
+def _abi_dataset_get_subset(handle, mv_indices, count, parameters):
+    idx = _np_from_buffer(mv_indices, count, 2)
+    return LGBM_DatasetGetSubset(handle, idx, parameters)
+
+
+# GetField hands out INTERNAL pointers (c_api.h:286-290 semantics); the
+# arrays are pinned here so the address outlives the call — freed with the
+# dataset (LGBM_DatasetFree clears the registry entry the cache keys on).
+_field_cache: dict = {}
+_FIELD_CODE = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+               np.dtype(np.int32): 2, np.dtype(np.int64): 3}
+
+
+def _abi_dataset_get_field(handle, field_name):
+    """-> (addr, length, dtype_code); addr valid until the next GetField
+    of the same field or DatasetFree.  Missing fields are an ERROR, as in
+    the reference (success never yields a NULL pointer)."""
+    arr = LGBM_DatasetGetField(handle, field_name)
+    if arr is None:
+        raise LightGBMError("Field %s not found" % field_name)
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _FIELD_CODE:
+        arr = np.ascontiguousarray(arr, dtype=np.float64)
+    _field_cache[(handle, field_name)] = arr
+    return (int(arr.ctypes.data), int(arr.size), _FIELD_CODE[arr.dtype])
+
+
+def _abi_booster_train_size(handle):
+    """grad/hess element count for UpdateOneIterCustom."""
+    gbdt = _get(handle)._gbdt
+    return int(gbdt.num_data * max(gbdt.num_tree_per_iteration, 1))
+
+
+def _abi_booster_update_custom(handle, mv_grad, mv_hess, n):
+    grad = _np_from_buffer(mv_grad, n, 0, copy=False)
+    hess = _np_from_buffer(mv_hess, n, 0, copy=False)
+    return LGBM_BoosterUpdateOneIterCustom(handle, grad, hess)
+
+
+def _abi_booster_get_predict(handle, data_idx):
+    return np.asarray(LGBM_BoosterGetPredict(handle, data_idx),
+                      dtype=np.float64)
+
+
+def _abi_booster_predict_csc(handle, mv_colptr, n_colptr, colptr_code,
+                             mv_indices, mv_data, nnz, data_code, num_row,
+                             predict_type, num_iteration):
+    colptr = _np_from_buffer(mv_colptr, n_colptr, colptr_code, copy=False)
+    indices = _np_from_buffer(mv_indices, nnz, 2, copy=False)
+    data = _np_from_buffer(mv_data, nnz, data_code, copy=False)
+    out = LGBM_BoosterPredictForCSC(handle, colptr, indices, data, num_row,
+                                    predict_type, num_iteration)
+    return np.ascontiguousarray(np.asarray(out, dtype=np.float64)
+                                .reshape(-1))

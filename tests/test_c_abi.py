@@ -50,8 +50,8 @@ def test_c_abi_train_eval_save_predict(tmp_path):
         ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_int64(N), ctypes.c_int(0)))
 
-    nd = ctypes.c_int64()
-    nf = ctypes.c_int64()
+    nd = ctypes.c_int()
+    nf = ctypes.c_int()
     _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
     _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)))
     assert nd.value == N and nf.value == F
@@ -80,7 +80,7 @@ def test_c_abi_train_eval_save_predict(tmp_path):
     _check(lib, lib.LGBM_BoosterPredictForMat(
         bst, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
         ctypes.c_int32(N), ctypes.c_int32(F), ctypes.c_int(1),
-        ctypes.c_int(0), ctypes.c_int(-1), ctypes.byref(out_len),
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.byref(out_len),
         preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
     assert out_len.value == N
     assert np.isfinite(preds).all() and 0 < preds.mean() < 1
@@ -98,7 +98,7 @@ def test_c_abi_train_eval_save_predict(tmp_path):
     _check(lib, lib.LGBM_BoosterPredictForMat(
         bst2, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
         ctypes.c_int32(N), ctypes.c_int32(F), ctypes.c_int(1),
-        ctypes.c_int(0), ctypes.c_int(-1), ctypes.byref(out_len),
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.byref(out_len),
         preds2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
     np.testing.assert_allclose(preds2, preds, rtol=1e-12)
 
@@ -114,6 +114,269 @@ def test_c_abi_train_eval_save_predict(tmp_path):
 
     _check(lib, lib.LGBM_BoosterFree(bst2))
     _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_abi_full_surface(tmp_path):
+    """Round-2 additions: push-rows streaming, subset, field get, feature
+    names, custom-objective update, merge/reset-parameter, leaf get/set,
+    dump, file predict.  Sampled-column create, CSR push, CSC predict and
+    reset-training-data are covered by test_c_abi_streaming_and_csc."""
+    lib = _lib()
+    rng = np.random.default_rng(6)
+    X = np.ascontiguousarray(rng.normal(size=(N, F)))
+    y = (X[:, 0] + X[:, 3] > 0).astype(np.float32)
+    params = b"objective=binary num_leaves=15 max_bin=63 verbose=-1 metric=auc"
+
+    # reference dataset, then stream rows into an aligned empty dataset
+    ds0 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(N), ctypes.c_int32(F), ctypes.c_int(1), params,
+        None, ctypes.byref(ds0)))
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(ds0, ctypes.c_int64(N),
+                                                  ctypes.byref(ds)))
+    half = N // 2
+    _check(lib, lib.LGBM_DatasetPushRows(
+        ds, X[:half].ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(half), ctypes.c_int32(F), ctypes.c_int32(0)))
+    tail = np.ascontiguousarray(X[half:])
+    _check(lib, lib.LGBM_DatasetPushRows(
+        ds, tail.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(N - half), ctypes.c_int32(F), ctypes.c_int32(half)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(N), ctypes.c_int(0)))
+
+    # feature names round-trip
+    names = [b"f%d" % i for i in range(F)]
+    arr = (ctypes.c_char_p * F)(*names)
+    _check(lib, lib.LGBM_DatasetSetFeatureNames(ds, arr, ctypes.c_int(F)))
+    bufs = [ctypes.create_string_buffer(64) for _ in range(F)]
+    outp = (ctypes.c_char_p * F)(*[ctypes.cast(b, ctypes.c_char_p)
+                                   for b in bufs])
+    n_names = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetFeatureNames(ds, outp,
+                                                ctypes.byref(n_names)))
+    assert n_names.value == F and bufs[3].value == b"f3"
+
+    # GetField hands back the label pointer
+    flen = ctypes.c_int()
+    fptr = ctypes.c_void_p()
+    ftype = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetField(ds, b"label", ctypes.byref(flen),
+                                         ctypes.byref(fptr),
+                                         ctypes.byref(ftype)))
+    assert flen.value == N
+    got = np.ctypeslib.as_array(
+        ctypes.cast(fptr, ctypes.POINTER(ctypes.c_float)), shape=(N,))
+    np.testing.assert_allclose(got, y, rtol=1e-6)
+
+    # subset
+    idx = np.arange(0, N, 2, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(len(idx)), params, ctypes.byref(sub)))
+    snd = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(snd)))
+    assert snd.value == len(idx)
+
+    # booster: custom-objective updates (logistic grad/hess)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+    nfeat = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetNumFeature(bst, ctypes.byref(nfeat)))
+    assert nfeat.value == F
+    fin = ctypes.c_int()
+    score = np.zeros(N, np.float64)
+    for _ in range(4):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(fin)))
+        plen = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterGetNumPredict(bst, ctypes.c_int(0),
+                                                  ctypes.byref(plen)))
+        assert plen.value == N
+        _check(lib, lib.LGBM_BoosterGetPredict(
+            bst, ctypes.c_int(0), ctypes.byref(plen),
+            score.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+
+    # eval/feature name lists
+    elen = ctypes.c_int()
+    nslots = max(F, 8)
+    ebufs = [ctypes.create_string_buffer(64) for _ in range(nslots)]
+    eoutp = (ctypes.c_char_p * nslots)(*[ctypes.cast(b, ctypes.c_char_p)
+                                         for b in ebufs])
+    _check(lib, lib.LGBM_BoosterGetEvalNames(bst, ctypes.byref(elen),
+                                             eoutp))
+    assert elen.value >= 1 and ebufs[0].value == b"auc"
+    _check(lib, lib.LGBM_BoosterGetFeatureNames(bst, ctypes.byref(elen),
+                                                eoutp))
+    assert elen.value == F
+
+    # leaf get/set + calc-num-predict + dump
+    leaf = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(bst, ctypes.c_int(0),
+                                             ctypes.c_int(0),
+                                             ctypes.byref(leaf)))
+    _check(lib, lib.LGBM_BoosterSetLeafValue(bst, ctypes.c_int(0),
+                                             ctypes.c_int(0),
+                                             ctypes.c_double(leaf.value)))
+    cnt = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(100), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.byref(cnt)))
+    assert cnt.value == 100
+    dlen = ctypes.c_int()
+    lib.LGBM_BoosterDumpModel(bst, ctypes.c_int(-1), ctypes.c_int(0),
+                              ctypes.byref(dlen), None)
+    dbuf = ctypes.create_string_buffer(dlen.value)
+    _check(lib, lib.LGBM_BoosterDumpModel(bst, ctypes.c_int(-1), dlen,
+                                          ctypes.byref(dlen), dbuf))
+    assert dbuf.value.decode().lstrip().startswith("{")
+
+    # reset parameter + merge + rollback interplay
+    _check(lib, lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.05"))
+    other = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(other)))
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(other, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterMerge(bst, other))
+
+    # file predict end-to-end
+    data_path = str(tmp_path / "pred.tsv")
+    np.savetxt(data_path, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.8g")
+    result_path = str(tmp_path / "preds.txt")
+    _check(lib, lib.LGBM_BoosterPredictForFile(
+        bst, data_path.encode(), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", result_path.encode()))
+    preds = np.loadtxt(result_path)
+    assert preds.shape == (N,) and np.isfinite(preds).all()
+
+    # SetLastError surfaces verbatim
+    lib.LGBM_SetLastError(b"custom message")
+    assert lib.LGBM_GetLastError() == b"custom message"
+
+    for h in (other, bst):
+        _check(lib, lib.LGBM_BoosterFree(h))
+    for d in (sub, ds, ds0):
+        _check(lib, lib.LGBM_DatasetFree(d))
+
+
+def test_c_abi_streaming_and_csc():
+    """The marshaling-heaviest exports: sampled-column create (double**/
+    int**), CSR row pushes, CSC predict, reset-training-data."""
+    lib = _lib()
+    rng = np.random.default_rng(8)
+    n, f = 600, 5
+    X = np.ascontiguousarray(rng.normal(size=(n, f)))
+    y = (X[:, 0] > 0).astype(np.float32)
+    params = b"objective=binary num_leaves=7 max_bin=31 verbose=-1"
+
+    # sampled-column create: every column fully sampled
+    col_arrays = [np.ascontiguousarray(X[:, c]) for c in range(f)]
+    idx_arrays = [np.arange(n, dtype=np.int32) for _ in range(f)]
+    col_ptrs = (ctypes.POINTER(ctypes.c_double) * f)(
+        *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+          for a in col_arrays])
+    idx_ptrs = (ctypes.POINTER(ctypes.c_int) * f)(
+        *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+          for a in idx_arrays])
+    per_col = (ctypes.c_int * f)(*([n] * f))
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromSampledColumn(
+        col_ptrs, idx_ptrs, ctypes.c_int32(f), per_col, ctypes.c_int32(n),
+        ctypes.c_int32(n), params, ctypes.byref(ds)))
+
+    # stream the rows in via CSR pushes (two chunks)
+    def csr_of(rows):
+        indptr, cols, vals = [0], [], []
+        for i in range(rows.shape[0]):
+            nz = np.nonzero(rows[i])[0]
+            cols.extend(nz.tolist())
+            vals.extend(rows[i, nz].tolist())
+            indptr.append(len(cols))
+        return (np.asarray(indptr, np.int32), np.asarray(cols, np.int32),
+                np.asarray(vals, np.float64))
+
+    half = n // 2
+    for start, chunk in ((0, X[:half]), (half, X[half:])):
+        indptr, cols, vals = csr_of(np.ascontiguousarray(chunk))
+        _check(lib, lib.LGBM_DatasetPushRowsByCSR(
+            ds, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(I32),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(f), ctypes.c_int64(start)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int(0)))
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # CSC predict over the same matrix
+    colptr, rows_i, vals_c = [0], [], []
+    for c in range(f):
+        nz = np.nonzero(X[:, c])[0]
+        rows_i.extend(nz.tolist())
+        vals_c.extend(X[nz, c].tolist())
+        colptr.append(len(rows_i))
+    colptr = np.asarray(colptr, np.int32)
+    rows_i = np.asarray(rows_i, np.int32)
+    vals_c = np.asarray(vals_c, np.float64)
+    out_len = ctypes.c_int64()
+    preds = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForCSC(
+        bst, colptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(I32),
+        rows_i.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals_c.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int64(len(colptr)), ctypes.c_int64(len(vals_c)),
+        ctypes.c_int64(n), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_len), preds.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n and np.isfinite(preds).all()
+
+    # dense predict must agree with CSC predict
+    dense_preds = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.byref(out_len),
+        dense_preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(preds, dense_preds, rtol=1e-9)
+
+    # reset training data to a fresh dataset and keep training
+    ds2 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1), params,
+        None, ctypes.byref(ds2)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds2, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int(0)))
+    _check(lib, lib.LGBM_BoosterResetTrainingData(bst, ds2))
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # missing field is an ERROR (success never yields NULL, as reference)
+    flen = ctypes.c_int()
+    fptr = ctypes.c_void_p()
+    ftype = ctypes.c_int()
+    rc = lib.LGBM_DatasetGetField(ds2, b"weight", ctypes.byref(flen),
+                                  ctypes.byref(fptr), ctypes.byref(ftype))
+    assert rc != 0 and b"not found" in lib.LGBM_GetLastError().lower()
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds2))
     _check(lib, lib.LGBM_DatasetFree(ds))
 
 
@@ -156,7 +419,7 @@ def test_c_abi_csr_create_and_predict():
         cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         vals.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
         ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
-        ctypes.c_int64(12), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.c_int64(12), ctypes.c_int(0), ctypes.c_int(-1), b"",
         ctypes.byref(out_len), preds.ctypes.data_as(
             ctypes.POINTER(ctypes.c_double))))
     assert out_len.value == len(y)
