@@ -82,6 +82,26 @@ def build_bundle_arrays(train_data: TrainingData):
     return arrays, Bg
 
 
+def resolve_wave_width(config: Config, num_leaves: int) -> int:
+    """tpu_wave_width=-1 -> auto: scale the wave to the frontier size.
+
+    Measured on v5e (1M x 28, BENCH_NOTES.md): W=16 is fastest at 63
+    leaves, W=32 at 255 — bigger waves amortize the per-sweep pass over
+    more splits, but at small trees they just pad the frontier.  Explicit
+    values (including 1 = the reference's exact split order) pass through.
+    """
+    w = int(config.tpu_wave_width)
+    if w > 0:
+        return w
+    if w != -1:
+        Log.fatal("tpu_wave_width must be positive or -1 (auto), got %d", w)
+    if num_leaves <= 31:
+        return 8
+    if num_leaves <= 127:
+        return 16
+    return 32
+
+
 def build_split_params(config: Config) -> SplitParams:
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
@@ -138,10 +158,10 @@ class SerialTreeLearner:
         # growth schedule: 'wave' batches the top-W pending splits per
         # sweep so the histogram work rides the MXU (ops/wave.py); 'exact'
         # is the per-split leaf-wise order of the reference (ops/grow.py).
-        # auto -> wave on TPU.  NOTE: the default tpu_wave_width (16) is an
-        # approximation of the leaf-wise ORDER (same greedy frontier,
-        # batched; quality parity shown in tests/test_wave.py) — set
-        # tpu_wave_width=1 for the reference's exact split sequence.
+        # auto -> wave on TPU.  NOTE: W (tpu_wave_width, default -1 = auto
+        # via resolve_wave_width) approximates the leaf-wise ORDER (same
+        # greedy frontier, batched; quality parity in tests/test_wave.py)
+        # — set tpu_wave_width=1 for the reference's exact split sequence.
         growth = config.tpu_growth
         if growth not in ("auto", "exact", "wave"):
             Log.fatal("Unknown tpu_growth %s (expected auto/exact/wave)",
@@ -158,7 +178,7 @@ class SerialTreeLearner:
             Log.fatal("tpu_histogram_mode=pallas_t requires tpu_growth=wave "
                       "(the transposed kernel is wave-only)")
         self.growth = growth
-        self.wave_width = int(config.tpu_wave_width)
+        self.wave_width = resolve_wave_width(config, self.num_leaves)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
         # every device column fits a nibble, store TWO columns per byte in
         # HBM; the wave engine unpacks per chunk in-scan, so the bin

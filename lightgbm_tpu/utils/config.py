@@ -326,11 +326,12 @@ class Config:
         # 'wave' batches the top-W pending splits per sweep for the MXU.
         # auto -> wave on TPU, exact elsewhere.
         "tpu_growth": ("str", "auto"),
-        # W in 'wave' growth: splits the top-W pending leaves per sweep.
-        # The default (16) approximates the leaf-wise ORDER (same greedy
-        # frontier, batched; quality parity in tests/test_wave.py); set 1
-        # to reproduce the reference's exact split sequence.
-        "tpu_wave_width": ("int", 16),
+        # W in 'wave' growth: splits the top-W pending leaves per sweep
+        # (same greedy frontier as leaf-wise, batched; quality parity in
+        # tests/test_wave.py).  -1 = auto, scaled to num_leaves (measured
+        # on v5e: W=16 fastest at 63 leaves, W=32 at 255); set 1 to
+        # reproduce the reference's exact split sequence.
+        "tpu_wave_width": ("int", -1),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
         # (VMEM-residency vs scan-overhead tradeoff on TPU)

@@ -24,6 +24,16 @@ from lightgbm_tpu.utils.config import Config
 N, F, L = 6000, 8, 31
 
 
+def test_wave_width_auto_policy():
+    """tpu_wave_width=-1 scales with num_leaves; explicit values win."""
+    from lightgbm_tpu.ops.learner import resolve_wave_width
+    assert resolve_wave_width(Config({"verbose": -1}), 15) == 8
+    assert resolve_wave_width(Config({"verbose": -1}), 63) == 16
+    assert resolve_wave_width(Config({"verbose": -1}), 255) == 32
+    cfg = Config({"verbose": -1, "tpu_wave_width": 1})
+    assert resolve_wave_width(cfg, 255) == 1
+
+
 def _setup(categorical=False, efb=False):
     rng = np.random.default_rng(11)
     X = rng.normal(size=(N, F))
