@@ -1,0 +1,31 @@
+"""docs/Parameters.md must stay in sync with the config registry."""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_parameters_doc_is_current(tmp_path):
+    doc = os.path.join(REPO, "docs", "Parameters.md")
+    with open(doc) as f:
+        committed = f.read()
+    out = str(tmp_path / "Parameters.md")   # never mutate the checkout
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run([sys.executable,
+                    os.path.join(REPO, "tools", "gen_params_doc.py"), out],
+                   check=True, env=env, cwd=REPO)
+    with open(out) as f:
+        regenerated = f.read()
+    assert committed == regenerated, (
+        "docs/Parameters.md is stale — run tools/gen_params_doc.py")
+
+
+def test_every_registry_key_documented():
+    from lightgbm_tpu.utils.config import Config
+    with open(os.path.join(REPO, "docs", "Parameters.md")) as f:
+        text = f.read()
+    missing = [k for k in Config._FIELDS if "| %s |" % k not in text]
+    assert not missing, "undocumented parameters: %s" % missing

@@ -1,0 +1,171 @@
+"""Generate docs/Parameters.md from the live config registry.
+
+The reference maintains docs/Parameters.md by hand; here the canonical
+keys, types, defaults, and alias table are read straight from
+lightgbm_tpu/utils/config.py so the document cannot drift from the code.
+Run: python tools/gen_params_doc.py [output_path]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.utils.config import ALIAS_TABLE, Config  # noqa: E402
+
+# short purpose lines for the keys users reach for most; everything else
+# still gets its row (type/default/aliases) from the registry
+NOTES = {
+    "task": "train / predict / convert_model",
+    "objective": "regression, regression_l1, huber, fair, poisson, binary,"
+                 " multiclass, multiclassova, lambdarank",
+    "boosting_type": "gbdt / dart / goss / infinite (InfiniteBoost)",
+    "tree_learner": "serial / feature / data / voting — see "
+                    "Parallel-Learning-Guide.md",
+    "metric": "l1, l2, rmse, huber, fair, poisson, binary_logloss, "
+              "binary_error, auc, multi_logloss, multi_error, ndcg, map",
+    "num_leaves": "max leaves per tree (leaf-wise growth)",
+    "max_bin": "max feature discretization bins; <=15 enables 4-bit packing",
+    "learning_rate": "shrinkage rate",
+    "num_iterations": "boosting rounds",
+    "min_data_in_leaf": "minimal rows per leaf",
+    "min_sum_hessian_in_leaf": "minimal hessian mass per leaf",
+    "feature_fraction": "per-tree feature subsample",
+    "bagging_fraction": "row subsample (with bagging_freq)",
+    "bagging_freq": "re-bag every k iterations (0 = off)",
+    "lambda_l1": "L1 regularization on leaf outputs",
+    "lambda_l2": "L2 regularization on leaf outputs",
+    "min_gain_to_split": "minimal gain to accept a split",
+    "max_depth": "depth limit (<=0 = unlimited)",
+    "early_stopping_round": "stop when no valid-set metric improves for k "
+                            "rounds",
+    "categorical_column": "categorical feature spec (indices or names)",
+    "two_round_loading": "streaming two-round text ingest (bounded host "
+                         "memory)",
+    "is_save_binary_file": "save the binned dataset for fast reload",
+    "histogram_pool_size": "MB budget for the per-leaf histogram cache; "
+                           "-1 = auto (see docs/TPU-Tuning.md)",
+    "top_k": "voting-parallel top-k (PV-Tree)",
+    "num_machines": "process count for multi-host training",
+    "is_unbalance": "auto-reweight unbalanced binary labels",
+    "scale_pos_weight": "manual positive-class weight",
+    "sigmoid": "sigmoid scale for binary/lambdarank",
+    "label_gain": "lambdarank per-label gains",
+    "max_position": "NDCG truncation for lambdarank",
+    "ndcg_eval_at": "NDCG/MAP eval positions",
+    "drop_rate": "DART tree drop probability",
+    "xgboost_dart_mode": "use xgboost's DART normalization",
+    "top_rate": "GOSS large-gradient keep fraction",
+    "other_rate": "GOSS small-gradient sample fraction",
+    "capacity": "InfiniteBoost ensemble capacity",
+    "pred_early_stop": "margin-based prediction early stop",
+    "use_missing": "enable missing-value handling",
+    "tpu_growth": "auto / exact / wave — growth schedule (wave batches the "
+                  "top-W splits per sweep on the MXU)",
+    "tpu_wave_width": "W in wave growth; -1 = auto by num_leaves; 1 = the "
+                      "reference's exact split order",
+    "tpu_wave_chunk": "row-chunk of the wave sweep (VMEM vs scan-overhead "
+                      "tradeoff)",
+    "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t "
+                          "histogram kernels",
+    "tpu_bin_pack": "auto / true / false — 4-bit bin packing (max_bin<=15)",
+    "tpu_use_dp": "float64 histograms/scores (gpu_use_dp analog)",
+    "tpu_profile_dir": "write a jax.profiler trace per training run",
+}
+
+GROUPS = [
+    ("Core", ["task", "objective", "boosting_type", "tree_learner",
+              "metric", "num_iterations", "learning_rate", "num_leaves",
+              "max_depth", "num_class", "seed"]),
+    ("Learning control", [
+        "min_data_in_leaf", "min_sum_hessian_in_leaf", "feature_fraction",
+        "feature_fraction_seed", "bagging_fraction", "bagging_freq",
+        "bagging_seed", "lambda_l1", "lambda_l2", "min_gain_to_split",
+        "early_stopping_round", "drop_rate", "skip_drop", "max_drop",
+        "uniform_drop", "xgboost_dart_mode", "drop_seed", "top_rate",
+        "other_rate", "capacity", "is_unbalance", "scale_pos_weight",
+        "sigmoid", "boost_from_average", "huber_delta", "fair_c",
+        "poisson_max_delta_step", "gaussian_eta", "label_gain",
+        "max_position", "ndcg_eval_at"]),
+    ("IO / dataset", [
+        "data", "valid_data", "max_bin", "min_data_in_bin",
+        "bin_construct_sample_cnt", "data_random_seed", "has_header",
+        "label_column", "weight_column", "group_column", "ignore_column",
+        "categorical_column", "two_round_loading", "is_save_binary_file",
+        "enable_load_from_binary_file", "is_pre_partition",
+        "is_enable_sparse", "sparse_threshold", "use_missing",
+        "enable_bundle", "max_conflict_rate", "input_model",
+        "output_model", "output_result", "snapshot_freq", "verbose",
+        "metric_freq", "is_training_metric"]),
+    ("Prediction", [
+        "num_iteration_predict", "is_predict_raw_score",
+        "is_predict_leaf_index", "pred_early_stop", "pred_early_stop_freq",
+        "pred_early_stop_margin", "convert_model",
+        "convert_model_language"]),
+    ("Distributed", [
+        "num_machines", "top_k", "local_listen_port", "time_out",
+        "machine_list_file", "histogram_pool_size"]),
+    ("TPU-native", [
+        "tpu_growth", "tpu_wave_width", "tpu_wave_chunk",
+        "tpu_histogram_mode", "tpu_bin_pack", "tpu_use_dp",
+        "tpu_profile_dir"]),
+]
+
+
+def aliases_of(key):
+    return sorted(a for a, c in ALIAS_TABLE.items() if c == key)
+
+
+def fmt_default(typ, val):
+    if val is None:
+        return "(unset)"
+    if typ == "bool":
+        return "true" if val else "false"
+    return str(val)
+
+
+def main():
+    fields = dict(Config._FIELDS)
+    out = []
+    out.append("# Parameters\n")
+    out.append(
+        "All parameter names, aliases, and defaults match the reference "
+        "(include/LightGBM/config.h:87-489); `tpu_*` keys are this "
+        "framework's additions.  GENERATED from the live registry by "
+        "`tools/gen_params_doc.py` — edit that script, not this file.\n")
+    covered = set()
+    for title, keys in GROUPS:
+        out.append("\n## %s\n" % title)
+        out.append("| parameter | type | default | aliases | note |")
+        out.append("|---|---|---|---|---|")
+        for k in keys:
+            if k == "two_round_loading":
+                k = "use_two_round_loading"
+            if k not in fields:
+                continue
+            covered.add(k)
+            typ, dv = fields[k]
+            al = ", ".join(aliases_of(k)) or ""
+            note = NOTES.get(k) or NOTES.get(k.replace("use_", "")) or ""
+            out.append("| %s | %s | %s | %s | %s |"
+                       % (k, typ, fmt_default(typ, dv), al, note))
+    rest = sorted(set(fields) - covered)
+    if rest:
+        out.append("\n## Other accepted keys\n")
+        out.append("| parameter | type | default | aliases |")
+        out.append("|---|---|---|---|")
+        for k in rest:
+            typ, dv = fields[k]
+            out.append("| %s | %s | %s | %s |"
+                       % (k, typ, fmt_default(typ, dv),
+                          ", ".join(aliases_of(k))))
+    path = (sys.argv[1] if len(sys.argv) > 1
+            else os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "Parameters.md"))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print("wrote %s (%d keys)" % (path, len(fields)))
+
+
+if __name__ == "__main__":
+    main()
