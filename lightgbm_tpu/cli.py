@@ -163,5 +163,10 @@ def main(argv=None) -> int:
     return 0
 
 
-if __name__ == "__main__":
+def console_entry() -> None:
+    """setuptools console-script entry (pyproject.toml)."""
     sys.exit(main())
+
+
+if __name__ == "__main__":
+    console_entry()

@@ -10,7 +10,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from lightgbm_tpu.utils.config import ALIAS_TABLE, Config  # noqa: E402
+from lightgbm_tpu.utils.config import (ALIAS_TABLE,  # noqa: E402
+                                       PARAMETER_SET, Config)
 
 # short purpose lines for the keys users reach for most; everything else
 # still gets its row (type/default/aliases) from the registry
@@ -39,8 +40,8 @@ NOTES = {
     "early_stopping_round": "stop when no valid-set metric improves for k "
                             "rounds",
     "categorical_column": "categorical feature spec (indices or names)",
-    "two_round_loading": "streaming two-round text ingest (bounded host "
-                         "memory)",
+    "use_two_round_loading": "streaming two-round text ingest (bounded "
+                             "host memory)",
     "is_save_binary_file": "save the binned dataset for fast reload",
     "histogram_pool_size": "MB budget for the per-leaf histogram cache; "
                            "-1 = auto (see docs/TPU-Tuning.md)",
@@ -90,7 +91,8 @@ GROUPS = [
         "data", "valid_data", "max_bin", "min_data_in_bin",
         "bin_construct_sample_cnt", "data_random_seed", "has_header",
         "label_column", "weight_column", "group_column", "ignore_column",
-        "categorical_column", "two_round_loading", "is_save_binary_file",
+        "categorical_column", "use_two_round_loading",
+        "is_save_binary_file",
         "enable_load_from_binary_file", "is_pre_partition",
         "is_enable_sparse", "sparse_threshold", "use_missing",
         "enable_bundle", "max_conflict_rate", "input_model",
@@ -125,6 +127,10 @@ def fmt_default(typ, val):
 
 def main():
     fields = dict(Config._FIELDS)
+    # parameters accepted via PARAMETER_SET but handled outside the typed
+    # field table (config-file plumbing, column-role strings, ...)
+    for k in sorted(PARAMETER_SET):
+        fields.setdefault(k, ("str", None))
     out = []
     out.append("# Parameters\n")
     out.append(
@@ -138,14 +144,13 @@ def main():
         out.append("| parameter | type | default | aliases | note |")
         out.append("|---|---|---|---|---|")
         for k in keys:
-            if k == "two_round_loading":
-                k = "use_two_round_loading"
             if k not in fields:
-                continue
+                raise SystemExit("GROUPS key %r is not a known parameter"
+                                 % k)
             covered.add(k)
             typ, dv = fields[k]
             al = ", ".join(aliases_of(k)) or ""
-            note = NOTES.get(k) or NOTES.get(k.replace("use_", "")) or ""
+            note = NOTES.get(k, "")
             out.append("| %s | %s | %s | %s | %s |"
                        % (k, typ, fmt_default(typ, dv), al, note))
     rest = sorted(set(fields) - covered)

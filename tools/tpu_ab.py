@@ -1,0 +1,80 @@
+"""One-shot TPU A/B: kernel microbench + end-to-end engine comparison.
+
+Run when the chip is reachable:  python tools/tpu_ab.py [n_rows]
+Probes the device first (fails fast if the axon tunnel is wedged), then
+times the wave-histogram kernels (v1 row-major, v2 transposed, XLA scan
+at several chunks) and the end-to-end engines (onehot / pallas /
+pallas_t) at the 255-leaf recipe, appending everything to
+tools/AB_RESULTS.md.
+"""
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def probe(seconds=90):
+    """Tiny matmul in a SUBPROCESS with a hard timeout — a wedged axon
+    tunnel hangs inside C calls, so in-process alarms never fire."""
+    code = ("import jax, jax.numpy as jnp; x = jnp.ones((256, 256)); "
+            "print(jax.default_backend(), float(jnp.sum(x @ x)))")
+    r = subprocess.run([sys.executable, "-c", code], timeout=seconds,
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError("TPU probe failed:\n" + r.stderr[-500:])
+    backend, s = r.stdout.split()[-2:]
+    return backend, float(s)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 999_424
+    backend, _ = probe()
+    lines = ["", "## %s UTC — backend=%s, n=%d"
+             % (datetime.datetime.utcnow().isoformat(timespec="seconds"),
+                backend, n)]
+    print(lines[-1], flush=True)
+
+    # ---- kernel microbench (round-trip-corrected)
+    import tools.bench_pallas_kernel as kb
+    import io
+    import contextlib
+    buf = io.StringIO()
+    sys.argv = ["bench_pallas_kernel.py", str(n)]
+    with contextlib.redirect_stdout(buf):
+        kb.main()
+    for ln in buf.getvalue().splitlines():
+        lines.append("    " + ln)
+        print("    " + ln, flush=True)
+
+    # ---- end-to-end engines at the 255-leaf recipe
+    from tools.bench_modes import make_data, run
+    X, y = make_data(n)
+    for mode in ("onehot", "pallas", "pallas_t"):
+        t0 = time.time()
+        try:
+            dt, auc = run(X, y, mode)
+            ln = ("    engine %-8s: %.3f s/iter (%.2f it/s) auc=%.4f "
+                  "[wall %.0fs]" % (mode, dt, 1.0 / dt, auc,
+                                    time.time() - t0))
+        except Exception as e:  # record, keep going
+            ln = "    engine %-8s: FAILED (%s)" % (mode, e)
+        lines.append(ln)
+        print(ln, flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "AB_RESULTS.md")
+    header = not os.path.exists(out)
+    with open(out, "a") as f:
+        if header:
+            f.write("# TPU A/B results (tools/tpu_ab.py)\n")
+        f.write("\n".join(lines) + "\n")
+    print("appended to", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
