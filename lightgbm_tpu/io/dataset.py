@@ -85,6 +85,11 @@ class TrainingData:
         if reference is not None:
             self._align_with(reference, data)
         elif comm is not None and comm.size > 1:
+            # ranks must agree on RNG-bearing params BEFORE any sampling
+            # (GlobalSyncUpByMin, application.cpp:118-199) — automatic
+            # here, like the reference's Application init
+            from ..parallel.comm import sync_config_across_ranks
+            sync_config_across_ranks(comm, config)
             self._construct_mappers_distributed(data, config, cats, comm)
             self._bin_data(data)
         else:

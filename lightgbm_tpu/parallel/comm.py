@@ -145,3 +145,39 @@ class JaxProcessComm(HostComm):
             raw = bytes(np.asarray(gathered[r][:int(sizes[r])]))
             out.append(json.loads(raw.decode()))
         return out
+
+
+def sync_up_by_min(comm: HostComm, value):
+    """GlobalSyncUpByMin (application.cpp:275-302): every rank adopts the
+    minimum — a deterministic agreement rule for config values that MUST
+    match across machines."""
+    return min(comm.allgather_obj(value))
+
+
+# config keys the reference min-syncs before distributed training
+# (application.cpp:118-122 data partition seed, :192-199 feature
+# sampling + DART drop seed)
+_SYNCED_KEYS = ("data_random_seed", "feature_fraction_seed",
+                "feature_fraction", "drop_seed")
+
+
+def sync_config_across_ranks(comm: HostComm, config) -> None:
+    """Make the RNG-bearing parameters identical on every rank so feature
+    sampling, bagging partitions, and DART drops agree (divergent values
+    would silently grow different trees per machine).  In-place, like the
+    reference mutating its config structs; called automatically by the
+    distributed dataset-construction path (io/dataset.py), before any
+    sampling happens — the Application-init timing of the reference.
+
+    ONE collective round: all four keys gather together.  Both the live
+    attribute and config.raw are updated so copy_with() derivatives keep
+    the synced values.
+    """
+    if comm is None or comm.size <= 1:
+        return
+    mine = [getattr(config, k) for k in _SYNCED_KEYS]
+    gathered = comm.allgather_obj(mine)
+    for key, vals in zip(_SYNCED_KEYS, zip(*gathered)):
+        v = min(vals)
+        setattr(config, key, v)
+        config.raw[key] = v

@@ -57,7 +57,8 @@ def main():
                   "verbose": -1, "tpu_growth": "exact",
                   "enable_bundle": False})
     comm = JaxProcessComm()
-    # distributed bin finding across REAL processes
+    # distributed bin finding across REAL processes (this also min-syncs
+    # the RNG-bearing params automatically, application.cpp:118-199)
     td = TrainingData.from_matrix(X_local, label=y_local, config=cfg,
                                   comm=comm)
     mesh = make_data_mesh()              # global mesh over both processes

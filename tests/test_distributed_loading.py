@@ -136,3 +136,27 @@ def test_more_ranks_than_features(data):
 
     tds = run_ranks(6, load)
     assert all(len(td.bin_mappers) == 3 for td in tds)
+
+
+def test_sync_config_across_ranks():
+    """GlobalSyncUpByMin analog (application.cpp:118-199): ranks that
+    were launched with divergent RNG-bearing params converge to the
+    minimum so every machine grows identical trees."""
+    from lightgbm_tpu.parallel.comm import sync_config_across_ranks
+
+    def worker(comm):
+        cfg = Config({"verbose": -1,
+                      "data_random_seed": 10 + comm.rank,
+                      "feature_fraction_seed": 5 - comm.rank,
+                      "feature_fraction": 1.0 - 0.1 * comm.rank,
+                      "drop_seed": 100 * (comm.rank + 1)})
+        sync_config_across_ranks(comm, cfg)
+        derived = cfg.copy_with(num_leaves=7)   # must keep synced values
+        return (cfg.data_random_seed, cfg.feature_fraction_seed,
+                cfg.feature_fraction, cfg.drop_seed,
+                derived.feature_fraction, derived.drop_seed)
+
+    results = run_ranks(3, worker)
+    assert len(set(results)) == 1
+    assert results[0] == (10, 3, pytest.approx(0.8), 100,
+                          pytest.approx(0.8), 100)
