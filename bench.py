@@ -16,11 +16,48 @@ tpu_wave_width=32 — the configuration a user gets by asking for speed;
 tpu_growth=exact reproduces the reference's leaf-wise split order.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_ITERS_PER_SEC = 0.133   # reference CLI, same data/recipe, this host
+
+
+def wait_for_device(probe_timeout=120, retries=8, gap=60):
+    """Fail fast (or ride out a recovering tunnel) instead of hanging.
+
+    Hangs (TimeoutExpired) are retried — the tunnel may be recovering;
+    non-hang probe errors are permanent and abort immediately with the
+    child's stderr.  A healthy probe on the WRONG backend (silent CPU
+    fallback) also aborts: the 10.5M-row recipe against the TPU baseline
+    would report a meaningless vs_baseline.
+    """
+    from lightgbm_tpu.utils.common import probe_device
+    for attempt in range(retries):
+        try:
+            backend = probe_device(timeout=probe_timeout)
+        except subprocess.TimeoutExpired:
+            if attempt + 1 < retries:
+                print("bench: device probe %d/%d timed out; retrying in %ds"
+                      % (attempt + 1, retries, gap), file=sys.stderr,
+                      flush=True)
+                time.sleep(gap)
+            continue
+        except RuntimeError as e:
+            print("bench: %s" % e, file=sys.stderr, flush=True)
+            sys.exit(2)
+        if backend != "tpu" and not os.environ.get("BENCH_ALLOW_CPU"):
+            print("bench: backend is %r, not tpu — aborting (set "
+                  "BENCH_ALLOW_CPU=1 to force)" % backend,
+                  file=sys.stderr, flush=True)
+            sys.exit(3)
+        return backend
+    print("bench: device unreachable after %d probes — aborting"
+          % retries, file=sys.stderr, flush=True)
+    sys.exit(2)
 
 N_ROWS = 10_500_000
 N_FEATURES = 28
@@ -44,6 +81,7 @@ def make_data():
 
 
 def main():
+    wait_for_device()
     import jax
     import lightgbm_tpu as lgb
 

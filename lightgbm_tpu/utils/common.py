@@ -61,3 +61,24 @@ kEpsilon = 1e-15
 kMissingValueRange = 1e-20
 kMaxTreeOutput = 100.0
 kMinScore = -np.inf
+
+
+def probe_device(timeout: float = 90.0) -> str:
+    """One tiny matmul in a SUBPROCESS; returns the backend name.
+
+    A wedged device tunnel (e.g. axon) blocks inside C calls where
+    in-process alarms never fire, so the probe must be a separate
+    process.  Raises subprocess.TimeoutExpired on a hang and
+    RuntimeError (with the child's stderr) on a non-hang failure —
+    callers can distinguish "maybe recovering, retry" from "permanently
+    broken, abort".
+    """
+    import subprocess
+    import sys
+    code = ("import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); "
+            "print(jax.default_backend(), float(jnp.sum(x @ x)))")
+    r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError("device probe failed:\n" + r.stderr[-500:])
+    return r.stdout.split()[-2]

@@ -19,21 +19,14 @@ import numpy as np
 
 
 def probe(seconds=90):
-    """Tiny matmul in a SUBPROCESS with a hard timeout — a wedged axon
-    tunnel hangs inside C calls, so in-process alarms never fire."""
-    code = ("import jax, jax.numpy as jnp; x = jnp.ones((256, 256)); "
-            "print(jax.default_backend(), float(jnp.sum(x @ x)))")
-    r = subprocess.run([sys.executable, "-c", code], timeout=seconds,
-                       capture_output=True, text=True)
-    if r.returncode != 0:
-        raise RuntimeError("TPU probe failed:\n" + r.stderr[-500:])
-    backend, s = r.stdout.split()[-2:]
-    return backend, float(s)
+    """Shared subprocess probe (lightgbm_tpu.utils.common.probe_device)."""
+    from lightgbm_tpu.utils.common import probe_device
+    return probe_device(timeout=seconds)
 
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 999_424
-    backend, _ = probe()
+    backend = probe()
     lines = ["", "## %s UTC — backend=%s, n=%d"
              % (datetime.datetime.utcnow().isoformat(timespec="seconds"),
                 backend, n)]
