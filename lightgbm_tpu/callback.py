@@ -67,8 +67,10 @@ def record_evaluation(eval_result: dict):
 
 
 def reset_parameter(**kwargs):
-    """Per-iteration parameter schedule; only learning_rate takes effect on
-    the in-process engine for now (mirrors reset_parameter semantics)."""
+    """Per-iteration parameter schedule (callback.py reset_parameter):
+    delegates to Booster.reset_parameter, which rebuilds the running
+    learner config in place — num_leaves, lambdas, bagging, etc. all take
+    effect, with a fast path for learning_rate."""
     def callback(env: CallbackEnv):
         new_parameters = {}
         for key, value in kwargs.items():
@@ -81,10 +83,13 @@ def reset_parameter(**kwargs):
             else:
                 raise ValueError("Only list and callable values are supported "
                                  "as a mapping from boosting round index to new parameter value.")
-            new_parameters[key] = new_param
+            # only CHANGED values trigger a reset (reference callback.py):
+            # an unchanged key would still force a per-iteration learner
+            # rebuild and wipe the bagging state
+            if new_param != env.params.get(key, None):
+                new_parameters[key] = new_param
         if new_parameters:
-            if "learning_rate" in new_parameters:
-                env.model._gbdt.shrinkage_rate = float(new_parameters["learning_rate"])
+            env.model.reset_parameter(new_parameters)
             env.params.update(new_parameters)
     callback.before_iteration = True
     callback.order = 10

@@ -258,3 +258,40 @@ def test_reset_parameter_in_place():
     dumped = bst._gbdt.models
     assert dumped[0].num_leaves > 7        # pre-reset trees: old width
     assert dumped[-1].num_leaves <= 7      # post-reset trees: new width
+
+
+def test_reset_parameter_callback_all_keys():
+    """The reset_parameter CALLBACK applies every scheduled key (it
+    delegates to Booster.reset_parameter), not just learning_rate."""
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=4,
+                    callbacks=[lgb.reset_parameter(
+                        learning_rate=[0.3, 0.2, 0.1, 0.05],
+                        lambda_l2=[0.0, 0.5, 1.0, 2.0])])
+    gb = bst._gbdt
+    assert gb.shrinkage_rate == 0.05
+    assert gb.learner.config.lambda_l2 == 2.0
+
+
+def test_reset_parameter_callback_skips_unchanged():
+    """A constant schedule must NOT trigger per-iteration resets (which
+    would wipe bagging state off-schedule and rebuild the learner)."""
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "bagging_fraction": 0.8, "bagging_freq": 5, "lambda_l2": 1.0}
+
+    def fit(callbacks):
+        return lgb.train(dict(params),
+                         lgb.Dataset(X, label=y, params=params),
+                         num_boost_round=6, callbacks=callbacks)
+
+    plain = fit([])
+    constant = fit([lgb.reset_parameter(lambda_l2=lambda i: 1.0)])
+    np.testing.assert_allclose(plain.predict(X), constant.predict(X),
+                               rtol=1e-12)
