@@ -32,3 +32,19 @@ def test_every_registry_key_documented():
     keys = set(Config._FIELDS) | set(PARAMETER_SET)
     missing = [k for k in sorted(keys) if "| %s |" % k not in text]
     assert not missing, "undocumented parameters: %s" % missing
+
+
+def test_python_api_doc_is_current(tmp_path):
+    doc = os.path.join(REPO, "docs", "Python-API.md")
+    with open(doc) as f:
+        committed = f.read()
+    out = str(tmp_path / "Python-API.md")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run([sys.executable,
+                    os.path.join(REPO, "tools", "gen_api_doc.py"), out],
+                   check=True, env=env, cwd=REPO)
+    with open(out) as f:
+        regenerated = f.read()
+    assert committed == regenerated, (
+        "docs/Python-API.md is stale — run tools/gen_api_doc.py")
