@@ -76,6 +76,7 @@ class Dataset:
 
     # ------------------------------------------------------------ construct
     def construct(self) -> "Dataset":
+        """Bin the raw data and build the device-ready store (lazy; no-op when already constructed)."""
         if self._handle is not None:
             return self
         params = dict(self.params)
@@ -158,16 +159,19 @@ class Dataset:
 
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, silent=False, params=None) -> "Dataset":
+        """Validation Dataset aligned to this one's bin mappers."""
         return Dataset(data, label=label, reference=self,
                        weight=weight, group=group, init_score=init_score,
                        silent=silent, params=params or self.params,
                        free_raw_data=self.free_raw_data)
 
     def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Align this dataset's bin mappers with a reference (train) dataset."""
         self.reference = reference
         return self
 
     def subset(self, used_indices, params=None) -> "Dataset":
+        """New Dataset over a row subset, sharing this one's bin mappers."""
         self.construct()
         used_indices = np.asarray(used_indices)
         from .io.sparse import SparseColumns
@@ -195,58 +199,69 @@ class Dataset:
 
     # ------------------------------------------------------------- metadata
     def set_label(self, label) -> "Dataset":
+        """Set the target vector."""
         self.label = label
         if self._handle is not None:
             self._handle.metadata.set_label(label)
         return self
 
     def get_label(self):
+        """The target vector, or None before it is set."""
         if self._handle is not None and self._handle.metadata.label is not None:
             return np.asarray(self._handle.metadata.label)
         return self.label
 
     def set_weight(self, weight) -> "Dataset":
+        """Set per-row weights."""
         self.weight = weight
         if self._handle is not None:
             self._handle.metadata.set_weights(weight)
         return self
 
     def get_weight(self):
+        """Per-row weights, or None."""
         if self._handle is not None and self._handle.metadata.weights is not None:
             return np.asarray(self._handle.metadata.weights)
         return self.weight
 
     def set_group(self, group) -> "Dataset":
+        """Set query/group sizes for ranking."""
         self.group = group
         if self._handle is not None:
             self._handle.metadata.set_query_counts(group)
         return self
 
     def get_group(self):
+        """Query/group sizes, or None."""
         if self._handle is not None and self._handle.metadata.query_boundaries is not None:
             return np.diff(self._handle.metadata.query_boundaries)
         return self.group
 
     def set_init_score(self, init_score) -> "Dataset":
+        """Set initial scores added to every prediction."""
         self.init_score = init_score
         if self._handle is not None:
             self._handle.metadata.set_init_score(init_score)
         return self
 
     def get_init_score(self):
+        """Initial scores, or None."""
         if self._handle is not None:
             return self._handle.metadata.init_score
         return self.init_score
 
     def set_field(self, field_name: str, data) -> None:
+        """Set a metadata field by name (label/weight/group/init_score)."""
         self.construct()
         self._handle.metadata.set_field(field_name, data)
 
     def get_field(self, field_name: str):
+        """Get a metadata field by name."""
         self.construct()
         return self._handle.metadata.get_field(field_name)
 
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Set the categorical feature spec (indices or names)."""
         if self._handle is not None and categorical_feature != self.categorical_feature:
             Log.warning("categorical_feature in Dataset is overridden; "
                         "new categorical_feature is %s", str(categorical_feature))
@@ -254,6 +269,7 @@ class Dataset:
         return self
 
     def set_feature_name(self, feature_name) -> "Dataset":
+        """Set feature names (list of str)."""
         self.feature_name = feature_name
         if feature_name not in (None, "auto") and self._handle is not None:
             self._handle.feature_names = list(feature_name)
@@ -270,6 +286,7 @@ class Dataset:
 
     # ------------------------------------------------------------------ info
     def num_data(self) -> int:
+        """Row count (constructs if needed)."""
         if self._handle is not None:
             return self._handle.num_data
         if isinstance(self.data, np.ndarray):
@@ -277,6 +294,7 @@ class Dataset:
         Log.fatal("Cannot get num_data before construct")
 
     def num_feature(self) -> int:
+        """Feature count (constructs if needed)."""
         if self._handle is not None:
             return self._handle.num_total_features
         if isinstance(self.data, np.ndarray):
@@ -284,6 +302,7 @@ class Dataset:
         Log.fatal("Cannot get num_feature before construct")
 
     def save_binary(self, filename: str) -> None:
+        """Save the constructed (binned) dataset for fast reload."""
         self.construct()
         self._handle.save_binary(filename)
 
@@ -371,6 +390,7 @@ class Booster:
 
     # ------------------------------------------------------------- training
     def add_valid(self, data: Dataset, name: str) -> "Booster":
+        """Register a validation set for eval/early stopping."""
         if not isinstance(data, Dataset):
             raise TypeError("Validation data should be Dataset instance, met %s"
                             % type(data).__name__)
@@ -457,14 +477,17 @@ class Booster:
         return self
 
     def rollback_one_iter(self) -> "Booster":
+        """Undo the most recent boosting iteration."""
         self._gbdt.rollback_one_iter()
         return self
 
     def current_iteration(self) -> int:
+        """Number of completed boosting iterations."""
         return self._gbdt.total_iterations()
 
     # ----------------------------------------------------------------- eval
     def eval(self, data: Dataset, name: str, feval=None) -> List[tuple]:
+        """Evaluate on an arbitrary dataset."""
         if data is self._train_set:
             return self.eval_train(feval)
         for i, vs in enumerate(self._valid_sets):
@@ -473,9 +496,11 @@ class Booster:
         raise LightGBMError("Data should be train set or a validation set")
 
     def eval_train(self, feval=None) -> List[tuple]:
+        """Evaluate on the training data."""
         return self.__eval(0, "training", feval)
 
     def eval_valid(self, feval=None) -> List[tuple]:
+        """Evaluate on every registered validation set."""
         out = []
         for i, name in enumerate(self.name_valid_sets):
             out.extend(self.__eval(i + 1, name, feval))
@@ -521,6 +546,7 @@ class Booster:
                 is_reshape: bool = True, pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0):
+        """Predict rows (numpy/pandas/CSR/CSC or a data file path)."""
         if isinstance(data, Dataset):
             raise TypeError("Cannot use Dataset instance for prediction, "
                             "please use raw data instead")
@@ -549,26 +575,33 @@ class Booster:
 
     # ------------------------------------------------------------ model I/O
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        """Write the model text file (loadable by the reference too)."""
         self._gbdt.save_model_to_file(filename, num_iteration)
         return self
 
     def model_to_string(self, num_iteration: int = -1) -> str:
+        """Model in the reference-compatible text format."""
         return self._gbdt.save_model_to_string(num_iteration)
 
     def dump_model(self, num_iteration: int = -1) -> dict:
+        """Model as a JSON-compatible dict."""
         import json
         return json.loads(self._gbdt.dump_model(num_iteration))
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """Per-feature split counts (importance_type='split')."""
         return self._gbdt.feature_importance()
 
     def feature_name(self) -> List[str]:
+        """Feature names of the training data."""
         return list(self._gbdt.feature_names)
 
     def num_feature(self) -> int:
+        """Number of features the model was trained on."""
         return self._gbdt.max_feature_idx + 1
 
     def num_trees(self) -> int:
+        """Total number of trees across all iterations."""
         return len(self._gbdt.models)
 
     # pickling support: serialize through the text model format

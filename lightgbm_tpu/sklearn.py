@@ -157,6 +157,7 @@ class LGBMModel(BaseEstimator):
             eval_init_score=None, eval_group=None, eval_metric=None,
             early_stopping_rounds=None, verbose=False, feature_name="auto",
             categorical_feature="auto", callbacks=None):
+        """Fit the estimator (scikit-learn contract)."""
         params, fobj = self._make_params()
         feval = _eval_function_wrapper(eval_metric) if callable(eval_metric) else None
         if isinstance(eval_metric, (str, list)):
@@ -192,6 +193,7 @@ class LGBMModel(BaseEstimator):
         return self
 
     def predict(self, X, raw_score=False, num_iteration=-1):
+        """Predict targets for X."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before predict")
         if num_iteration <= 0 and self._best_iteration > 0:
@@ -234,6 +236,7 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
         self._objective_default = "binary"
 
     def fit(self, X, y, **kwargs):
+        """Fit the classifier; encodes labels and picks the objective."""
         self._le = LabelEncoder().fit(y) if _SKLEARN_INSTALLED else None
         if self._le is not None:
             y_enc = self._le.transform(y)
@@ -263,6 +266,7 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
         return self._classes[idx]
 
     def predict_proba(self, X, raw_score=False, num_iteration=-1):
+        """Class probability estimates for X."""
         out = super().predict(X, raw_score=raw_score,
                               num_iteration=num_iteration)
         if raw_score:
@@ -286,6 +290,7 @@ class LGBMRanker(LGBMModel):
         self._objective_default = "lambdarank"
 
     def fit(self, X, y, group=None, **kwargs):
+        """Fit the ranker; group gives query sizes."""
         if group is None:
             raise ValueError("Should set group for ranking task")
         return super().fit(X, y, group=group, **kwargs)
