@@ -286,7 +286,8 @@ class Dataset:
 
     # ------------------------------------------------------------------ info
     def num_data(self) -> int:
-        """Row count (constructs if needed)."""
+        """Row count; requires raw ndarray data or a constructed
+        dataset (matches the reference's construct-first contract)."""
         if self._handle is not None:
             return self._handle.num_data
         if isinstance(self.data, np.ndarray):
@@ -294,7 +295,8 @@ class Dataset:
         Log.fatal("Cannot get num_data before construct")
 
     def num_feature(self) -> int:
-        """Feature count (constructs if needed)."""
+        """Feature count; requires raw ndarray data or a constructed
+        dataset (matches the reference's construct-first contract)."""
         if self._handle is not None:
             return self._handle.num_total_features
         if isinstance(self.data, np.ndarray):
@@ -589,8 +591,8 @@ class Booster:
         return json.loads(self._gbdt.dump_model(num_iteration))
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
-        """Per-feature split counts (importance_type='split')."""
-        return self._gbdt.feature_importance()
+        """Per-feature importance: 'split' counts or total 'gain'."""
+        return self._gbdt.feature_importance(importance_type)
 
     def feature_name(self) -> List[str]:
         """Feature names of the training data."""

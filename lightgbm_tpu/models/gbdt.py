@@ -730,8 +730,19 @@ class GBDT:
         pairs.sort(key=lambda p: -p[0])
         return pairs
 
-    def feature_importance(self) -> np.ndarray:
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """'split' = times a feature is used; 'gain' = total gain of the
+        splits using it (python-package basic.py:1646-1680 semantics)."""
+        if importance_type not in ("split", "gain"):
+            raise KeyError("importance_type must be split or gain")
         self._materialize()
+        if importance_type == "gain":
+            gains = np.zeros(self.max_feature_idx + 1, dtype=np.float64)
+            for tree in self.models:
+                for i in range(tree.num_leaves - 1):
+                    if tree.split_gain[i] > 0:
+                        gains[tree.split_feature[i]] += tree.split_gain[i]
+            return gains
         counts = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
         for tree in self.models:
             for i in range(tree.num_leaves - 1):

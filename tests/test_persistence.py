@@ -1,0 +1,95 @@
+"""Persistence round-trips the reference's python tests cover:
+pickle/deepcopy of Booster (test_engine.py), and sklearn-ecosystem
+integration — clone, GridSearchCV, joblib — (test_sklearn.py).
+"""
+import copy
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _fit(n=1200, f=6, rounds=5):
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=rounds)
+    return bst, X
+
+
+def test_booster_pickle_roundtrip():
+    bst, X = _fit()
+    want = bst.predict(X)
+    clone = pickle.loads(pickle.dumps(bst))
+    np.testing.assert_allclose(clone.predict(X), want, rtol=1e-12)
+    assert clone.num_trees() == bst.num_trees()
+
+
+def test_booster_deepcopy_independent():
+    bst, X = _fit()
+    want = bst.predict(X)
+    dup = copy.deepcopy(bst)
+    np.testing.assert_allclose(dup.predict(X), want, rtol=1e-12)
+    # mutating the copy's trees must not touch the original
+    dup._gbdt.models[0].shrink(0.1)
+    assert not np.allclose(dup.predict(X), want)
+    np.testing.assert_allclose(bst.predict(X), want, rtol=1e-12)
+
+
+def test_sklearn_clone_and_refit():
+    from sklearn.base import clone
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] > 0).astype(int)
+    est = lgb.LGBMClassifier(n_estimators=5, num_leaves=15)
+    est.fit(X, y)
+    dup = clone(est)                     # unfitted copy with same params
+    assert dup.get_params()["n_estimators"] == 5
+    dup.fit(X, y)
+    np.testing.assert_allclose(dup.predict_proba(X), est.predict_proba(X),
+                               rtol=1e-9)
+
+
+def test_sklearn_gridsearch():
+    from sklearn.model_selection import GridSearchCV
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    gs = GridSearchCV(lgb.LGBMClassifier(n_estimators=4, verbose=-1),
+                      {"num_leaves": [7, 15]}, cv=2, scoring="accuracy")
+    gs.fit(X, y)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+    assert gs.best_score_ > 0.7
+
+
+def test_sklearn_joblib_roundtrip(tmp_path):
+    joblib = pytest.importorskip("joblib")
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(800, 5))
+    y = rng.normal(size=800) + X[:, 0]
+    est = lgb.LGBMRegressor(n_estimators=5, num_leaves=15)
+    est.fit(X, y)
+    path = tmp_path / "est.joblib"
+    joblib.dump(est, path)
+    loaded = joblib.load(path)
+    np.testing.assert_allclose(loaded.predict(X), est.predict(X),
+                               rtol=1e-12)
+
+
+def test_feature_importance_types():
+    """'split' counts and 'gain' totals (basic.py:1646-1680); unknown
+    types raise KeyError like the reference."""
+    bst, X = _fit()
+    split = bst.feature_importance("split")
+    gain = bst.feature_importance("gain")
+    assert split.sum() > 0 and gain.sum() > 0
+    assert split.shape == gain.shape
+    # the engineered signal features dominate both measures
+    assert split[0] + split[1] >= split[2:].sum()
+    assert gain[0] + gain[1] > gain[2:].sum()
+    with pytest.raises(KeyError):
+        bst.feature_importance("cover")
