@@ -478,6 +478,37 @@ class Booster:
         self._cfg = cfg          # later add_valid must see the new config
         return self
 
+    # ------------------------------------------------------- attributes
+    def attr(self, key: str):
+        """Get a user attribute (basic.py:1769), or None when unset."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set user attributes; a None value deletes the key
+        (basic.py:1785-1800)."""
+        store = self.__dict__.setdefault("_attr", {})
+        for key, value in kwargs.items():
+            if value is None:
+                store.pop(key, None)
+            else:
+                store[key] = str(value)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Display name of the training set in eval output."""
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Drop train AND validation dataset references so their raw data
+        can be collected (basic.py:1281-1283).  The trained model and
+        prediction remain usable; further update()/eval calls need new
+        datasets."""
+        self._train_set = None
+        self._valid_sets = []
+        self.name_valid_sets = []
+        return self
+
     def rollback_one_iter(self) -> "Booster":
         """Undo the most recent boosting iteration."""
         self._gbdt.rollback_one_iter()
@@ -499,7 +530,8 @@ class Booster:
 
     def eval_train(self, feval=None) -> List[tuple]:
         """Evaluate on the training data."""
-        return self.__eval(0, "training", feval)
+        return self.__eval(0, getattr(self, "_train_data_name",
+                                      "training"), feval)
 
     def eval_valid(self, feval=None) -> List[tuple]:
         """Evaluate on every registered validation set."""
@@ -611,13 +643,18 @@ class Booster:
         state = {"params": self.params,
                  "model_str": self.model_to_string(),
                  "best_iteration": self.best_iteration,
-                 "best_score": self.best_score}
+                 "best_score": self.best_score,
+                 "attr": dict(getattr(self, "_attr", {})),
+                 "train_data_name": getattr(self, "_train_data_name",
+                                            "training")}
         return state
 
     def __setstate__(self, state):
         self.params = state["params"]
         self.best_iteration = state.get("best_iteration", -1)
         self.best_score = state.get("best_score", {})
+        self._attr = dict(state.get("attr", {}))
+        self._train_data_name = state.get("train_data_name", "training")
         self._train_set = None
         self._valid_sets = []
         self.name_valid_sets = []

@@ -213,6 +213,16 @@ class LGBMModel(BaseEstimator):
     def booster_(self):
         return self._Booster
 
+    def apply(self, X, num_iteration=-1):
+        """Per-row leaf indices of every tree (sklearn.py apply); uses
+        the early-stopped best iteration like predict()."""
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted")
+        if num_iteration <= 0 and self._best_iteration > 0:
+            num_iteration = self._best_iteration
+        return self._Booster.predict(X, num_iteration=num_iteration,
+                                     pred_leaf=True)
+
     @property
     def evals_result_(self):
         return self._evals_result

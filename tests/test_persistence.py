@@ -93,3 +93,40 @@ def test_feature_importance_types():
     assert gain[0] + gain[1] > gain[2:].sum()
     with pytest.raises(KeyError):
         bst.feature_importance("cover")
+
+
+def test_booster_attrs_and_free_dataset():
+    """attr/set_attr (basic.py:1769-1800), set_train_data_name,
+    free_dataset."""
+    bst, X = _fit()
+    assert bst.attr("note") is None
+    bst.set_attr(note="hello", run="7")
+    assert bst.attr("note") == "hello" and bst.attr("run") == "7"
+    bst.set_attr(note=None)
+    assert bst.attr("note") is None
+    bst.set_train_data_name("mytrain")
+    want = bst.predict(X)
+    bst.free_dataset()
+    np.testing.assert_allclose(bst.predict(X), want, rtol=1e-12)
+
+
+def test_sklearn_apply_leaf_indices():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(int)
+    est = lgb.LGBMClassifier(n_estimators=3, num_leaves=7)
+    est.fit(X, y)
+    leaves = est.apply(X)
+    assert leaves.shape == (500, 3)
+    assert leaves.min() >= 0 and leaves.max() < 7
+
+
+def test_attrs_survive_pickle_and_train_name_shows():
+    bst, X = _fit()
+    bst.set_attr(best_note="0.9")
+    bst.set_train_data_name("mytrain")
+    names = [t[0] for t in bst.eval_train()]
+    assert names and all(n == "mytrain" for n in names)
+    clone = pickle.loads(pickle.dumps(bst))
+    assert clone.attr("best_note") == "0.9"
+    assert clone._train_data_name == "mytrain"
