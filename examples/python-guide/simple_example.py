@@ -1,0 +1,37 @@
+"""The Python API end to end: train, validate, save, reload, predict.
+
+Run from the repo root:  python examples/python-guide/simple_example.py
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(20_000, 10))
+y = (X[:, 0] + np.sin(X[:, 1] * 2) + 0.3 * rng.normal(size=20_000) > 0)
+X_train, X_test = X[:16_000], X[16_000:]
+y_train, y_test = y[:16_000].astype(float), y[16_000:].astype(float)
+
+train_set = lgb.Dataset(X_train, label=y_train)
+valid_set = train_set.create_valid(X_test, label=y_test)
+
+evals = {}
+bst = lgb.train(
+    {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+     "metric": ["auc", "binary_logloss"], "verbose": -1},
+    train_set, num_boost_round=60, valid_sets=[valid_set],
+    valid_names=["holdout"], early_stopping_rounds=10,
+    callbacks=[lgb.record_evaluation(evals)])
+
+print("best iteration:", bst.best_iteration)
+print("holdout AUC:", evals["holdout"]["auc"][-1])
+
+bst.save_model("/tmp/simple_example.model")
+reloaded = lgb.Booster(model_file="/tmp/simple_example.model")
+pred = reloaded.predict(X_test)
+print("prediction head:", np.round(pred[:5], 4))
+
+# sklearn flavor
+clf = lgb.LGBMClassifier(n_estimators=40, num_leaves=31)
+clf.fit(X_train, y_train.astype(int))
+print("sklearn accuracy:", (clf.predict(X_test) == y_test).mean())

@@ -484,14 +484,16 @@ class Booster:
         return getattr(self, "_attr", {}).get(key)
 
     def set_attr(self, **kwargs) -> "Booster":
-        """Set user attributes; a None value deletes the key
-        (basic.py:1785-1800)."""
+        """Set STRING attributes; a None value deletes the key
+        (basic.py:1785-1800 — non-strings raise like the reference)."""
         store = self.__dict__.setdefault("_attr", {})
         for key, value in kwargs.items():
             if value is None:
                 store.pop(key, None)
+            elif not isinstance(value, str):
+                raise ValueError("Set attr only accepts strings")
             else:
-                store[key] = str(value)
+                store[key] = value
         return self
 
     def set_train_data_name(self, name: str) -> "Booster":
@@ -500,13 +502,15 @@ class Booster:
         return self
 
     def free_dataset(self) -> "Booster":
-        """Drop train AND validation dataset references so their raw data
-        can be collected (basic.py:1281-1283).  The trained model and
-        prediction remain usable; further update()/eval calls need new
-        datasets."""
+        """Release the Python-side train/valid Dataset references
+        (basic.py:1281-1283; the reference engine calls this after
+        training to let raw data be collected).  The engine retains its
+        device-side data, so prediction, update(), and built-in-metric
+        eval keep working; only custom fevals need the freed Dataset
+        objects and will raise.  Valid slots become None PLACEHOLDERS so
+        later add_valid keeps eval indices aligned."""
         self._train_set = None
-        self._valid_sets = []
-        self.name_valid_sets = []
+        self._valid_sets = [None] * len(self._valid_sets)
         return self
 
     def rollback_one_iter(self) -> "Booster":
@@ -552,6 +556,10 @@ class Booster:
                 ds = self._train_set
             else:
                 ds = self._valid_sets[data_idx - 1]
+            if ds is None:
+                raise LightGBMError(
+                    "Custom eval needs the Dataset, but it was released "
+                    "by free_dataset()")
             ret = feval(self.__inner_predict_for_eval(data_idx), ds)
             if isinstance(ret, list):
                 for fname, val, hb in ret:

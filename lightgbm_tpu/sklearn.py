@@ -196,10 +196,9 @@ class LGBMModel(BaseEstimator):
         """Predict targets for X."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before predict")
-        if num_iteration <= 0 and self._best_iteration > 0:
-            num_iteration = self._best_iteration
-        return self._Booster.predict(X, raw_score=raw_score,
-                                     num_iteration=num_iteration)
+        return self._Booster.predict(
+            X, raw_score=raw_score,
+            num_iteration=self._resolve_num_iteration(num_iteration))
 
     @property
     def n_features_(self):
@@ -213,15 +212,21 @@ class LGBMModel(BaseEstimator):
     def booster_(self):
         return self._Booster
 
+    def _resolve_num_iteration(self, num_iteration: int) -> int:
+        """<=0 falls back to the early-stopped best iteration (shared by
+        predict/predict_proba/apply so they always agree)."""
+        if num_iteration <= 0 and self._best_iteration > 0:
+            return self._best_iteration
+        return num_iteration
+
     def apply(self, X, num_iteration=-1):
         """Per-row leaf indices of every tree (sklearn.py apply); uses
         the early-stopped best iteration like predict()."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted")
-        if num_iteration <= 0 and self._best_iteration > 0:
-            num_iteration = self._best_iteration
-        return self._Booster.predict(X, num_iteration=num_iteration,
-                                     pred_leaf=True)
+        return self._Booster.predict(
+            X, num_iteration=self._resolve_num_iteration(num_iteration),
+            pred_leaf=True)
 
     @property
     def evals_result_(self):

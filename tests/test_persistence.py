@@ -130,3 +130,34 @@ def test_attrs_survive_pickle_and_train_name_shows():
     clone = pickle.loads(pickle.dumps(bst))
     assert clone.attr("best_note") == "0.9"
     assert clone._train_data_name == "mytrain"
+
+
+def test_set_attr_rejects_non_strings():
+    bst, _ = _fit()
+    with pytest.raises(ValueError):
+        bst.set_attr(threshold=0.5)
+
+
+def test_free_dataset_keeps_valid_indices_aligned():
+    """After free_dataset, a new add_valid must NOT report the old
+    dataset's scores under the new name, and custom fevals on freed
+    slots raise instead of mixing datasets."""
+    rng = np.random.default_rng(30)
+    X = rng.normal(size=(1200, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "metric": "auc"}
+    train = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=train)
+    v1 = train.create_valid(X[:300], label=y[:300])
+    bst.add_valid(v1, "v1")
+    for _ in range(3):
+        bst.update()
+    bst.free_dataset()
+    # built-in metrics still work on the engine-retained data
+    names = [t[0] for t in bst.eval_valid()]
+    assert names == ["v1"]
+    # custom eval needs the freed Dataset -> clear error
+    with pytest.raises(lgb.LightGBMError):
+        bst.eval_valid(feval=lambda preds, ds: ("f", float(ds.num_data()),
+                                                True))
