@@ -415,6 +415,10 @@ class Booster:
             Log.fatal("Resetting train set inside update is not supported yet")
         if fobj is None:
             return self._gbdt.train_one_iter(None, None, False)
+        if self._train_set is None:
+            raise LightGBMError(
+                "Custom objective needs the train Dataset, but it was "
+                "released by free_dataset()")
         grad, hess = fobj(self.__inner_predict_raw(0), self._train_set)
         return self.__boost(grad, hess)
 

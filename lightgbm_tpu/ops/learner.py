@@ -135,9 +135,9 @@ class SerialTreeLearner:
         self.params = build_split_params(config)
         hist_mode = config.tpu_histogram_mode
         if hist_mode not in ("auto", "onehot", "scatter", "pallas",
-                             "pallas_t"):
+                             "pallas_t", "pallas_f"):
             Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
-                      "scatter/pallas/pallas_t)", hist_mode)
+                      "scatter/pallas/pallas_t/pallas_f)", hist_mode)
         if hist_mode == "auto":
             # measured on v5e (1M x 28, varying inputs to defeat dispatch
             # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
@@ -168,15 +168,15 @@ class SerialTreeLearner:
                       growth)
         if growth == "auto":
             # 'pallas' is the exact engine's per-leaf kernel; 'pallas_t'
-            # exists only as a wave kernel
-            if hist_mode == "pallas_t":
+            # and 'pallas_f' exist only as wave kernels
+            if hist_mode in ("pallas_t", "pallas_f"):
                 growth = "wave"
             else:
                 growth = ("wave" if jax.default_backend() == "tpu"
                           and hist_mode != "pallas" else "exact")
-        if growth == "exact" and hist_mode == "pallas_t":
-            Log.fatal("tpu_histogram_mode=pallas_t requires tpu_growth=wave "
-                      "(the transposed kernel is wave-only)")
+        if growth == "exact" and hist_mode in ("pallas_t", "pallas_f"):
+            Log.fatal("tpu_histogram_mode=%s requires tpu_growth=wave "
+                      "(this kernel is wave-only)" % hist_mode)
         self.growth = growth
         self.wave_width = resolve_wave_width(config, self.num_leaves)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
@@ -286,7 +286,8 @@ class SerialTreeLearner:
             # wave-only pallas_t kernel maps to onehot here — mesh
             # subclasses that run the wave schedule install their own
             # pallas_t-capable grow right after this constructor
-            base_mode = "onehot" if hist_mode == "pallas_t" else hist_mode
+            base_mode = ("onehot" if hist_mode in ("pallas_t", "pallas_f")
+                         else hist_mode)
             self._grow = make_grow_fn(self.num_leaves, self.num_bins,
                                       self.meta, self.params,
                                       config.max_depth, hist_mode=base_mode,

@@ -42,21 +42,30 @@ def _bin_pad(num_bins: int) -> int:
     return ((num_bins + 127) // 128) * 128
 
 
-def _split_weights(lid_ref, w3_ref, cid_ref):
-    """Per-child masked weight channels, split into exact bf16 hi + scaled
-    bf16 residual for f32-quality MXU accumulation.
+def _tile_plan(n, fc, bp, row_tile):
+    """Shared tile sizing for every wave kernel: bins per inner sub-block
+    (~512 lanes per one-hot tile AND a divisor of bp so the loop covers
+    every bin), and the row-tile size that keeps the (Cg, bsub*fc)
+    f32/bf16 temporaries within the raised VMEM budget.  One copy so the
+    policy cannot diverge across kernel layouts."""
+    bsub = 1
+    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
+        bsub *= 2
+    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
+    c = min(c, max(n, 1))
+    return bsub, c
 
-    match (Cg, K) x channels (Cg, 3) -> (Cg, 3K), then an exact hi/lo split
-    by mantissa truncation — a bf16 round-trip would be folded to identity
-    under --xla_allow_excess_precision, silently zeroing the residual term
-    (observed on v5e).  The residual is scaled by 2^8 (exact) into bf16
-    range; Mosaic's f32->bf16 cast TRUNCATES (measured: biased sums ~100x
-    above round-to-nearest theory), so it is rounded manually in bit
-    arithmetic first — after that the cast drops only zero bits.  Shared by
-    both kernel layouts so the precision workarounds cannot diverge.
+
+def _split_weights_from_match(match, w3):
+    """(Cg, K) 0/1 match x (Cg, 3) channels -> bf16 hi/lo weight pair.
+
+    Exact hi/lo split by mantissa truncation — a bf16 round-trip would be
+    folded to identity under --xla_allow_excess_precision, silently
+    zeroing the residual term (observed on v5e).  The residual is scaled
+    by 2^8 (exact) into bf16 range; Mosaic's f32->bf16 cast TRUNCATES
+    (measured: biased sums ~100x above round-to-nearest theory), so it is
+    rounded manually in bit arithmetic first.
     """
-    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
-    w3 = w3_ref[:]                                           # (Cg, 3)
     wmat = jnp.concatenate(
         [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
     wh_f32 = pltpu.bitcast(
@@ -68,6 +77,14 @@ def _split_weights(lid_ref, w3_ref, cid_ref):
         (pltpu.bitcast(wl_f32, jnp.uint32) + jnp.uint32(0x8000))
         & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
     return wh, wl
+
+
+def _split_weights(lid_ref, w3_ref, cid_ref):
+    """Per-child masked weights in hi/lo bf16, from leaf-id match.
+    Shared by every kernel layout so the precision workarounds in
+    _split_weights_from_match cannot diverge."""
+    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
+    return _split_weights_from_match(match, w3_ref[:])
 
 
 def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
@@ -130,17 +147,7 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    # bins per inner sub-block: ~512 lanes per one-hot tile, and a DIVISOR
-    # of bp so the sub-block loop covers every bin (bp can be 384 etc. —
-    # powers of two do not always divide it)
-    bsub = 1
-    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
-        bsub *= 2
-    # keep the (Cg, bsub*fc) f32/bf16 tiles within ~16MB each so a handful
-    # of live temporaries fit the raised VMEM budget; bigger row tiles
-    # amortize the per-grid-step pipeline overhead
-    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
-    c = min(c, max(n, 1))
+    bsub, c = _tile_plan(n, fc, bp, row_tile)
     pad = (-n) % c
     lid2 = leaf_id[:, None]
     w3f = w3.astype(jnp.float32)
@@ -239,11 +246,7 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
     fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    bsub = 1
-    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
-        bsub *= 2
-    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
-    c = min(c, max(n, 1))
+    bsub, c = _tile_plan(n, fc, bp, row_tile)
     pad = (-n) % c
     lid2 = leaf_id[:, None]
     w3f = w3.astype(jnp.float32)
@@ -277,3 +280,151 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
     )(X_t, lid2, w3f, child_id[None, :])
     h = flat.reshape(bp, fc, 3, k)[:num_bins]
     return jnp.transpose(h, (3, 1, 0, 2))
+
+
+# --------------------------------------------------------------------------
+# v3: FUSED partition + histogram.  The wave engine's XLA path runs a
+# chunked partition scan (leaf-split-table lookup + routing) and then the
+# histogram kernel — two passes over X.  This kernel does both in one:
+# per row tile, look up the (L, 10) split table by leaf id (one-hot
+# contraction on the MXU), route rows to their child, emit the updated
+# leaf ids, and accumulate the child histograms — ONE read of X per wave.
+# Split-table column layout matches ops/wave.py (active, device column,
+# threshold, is_cat, default bin, default-left, right-leaf id, bundle
+# offset/adjust/span).
+# --------------------------------------------------------------------------
+
+def _wave_fused_kernel(x_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
+                       lid_out_ref, out_ref,
+                       *, bp, fc, k, bsub, packed, bundled):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xi = x_ref[:]
+    if packed:
+        from .pack import unpack4
+        xi = unpack4(xi, fc)
+    xint = xi.astype(jnp.int32)                          # (Cg, Fc)
+    x = xint.astype(jnp.float32)
+    cg = x.shape[0]
+    L = tbl_ref.shape[0]
+
+    # ---- split-table lookup by leaf id: one-hot (Cg, L) @ (L, 10).
+    # f32 MXU with HIGHEST precision — table entries are integers < 2^24
+    # (column ids, thresholds, leaf ids) and must come back exact.
+    lc = lid_ref[:]                                      # (Cg, 1) int32
+    leaf_iota = jax.lax.broadcasted_iota(jnp.int32, (cg, L), 1)
+    leaf_oh = (lc == leaf_iota).astype(jnp.float32)
+    r = jax.lax.dot_general(
+        leaf_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)             # (Cg, 10)
+
+    # ---- routing (same decision algebra as ops/wave.py wave_pass)
+    active = r[:, 0:1] > 0.5
+    cj = r[:, 1:2].astype(jnp.int32)                     # (Cg, 1)
+    f_iota = jax.lax.broadcasted_iota(jnp.int32, (cg, fc), 1)
+    colv = jnp.sum(jnp.where(cj == f_iota, xint, 0), axis=1,
+                   keepdims=True)                        # (Cg, 1)
+    if bundled:
+        goff = r[:, 7:8].astype(jnp.int32)
+        span = r[:, 9:10].astype(jnp.int32)
+        in_range = (colv >= goff) & (colv < goff + span)
+        colv = jnp.where(in_range,
+                         colv - goff + r[:, 8:9].astype(jnp.int32),
+                         r[:, 4:5].astype(jnp.int32))
+    thr = r[:, 2:3].astype(jnp.int32)
+    is_cat = r[:, 3:4] > 0.5
+    gl = jnp.where(is_cat, colv == thr, colv <= thr)
+    gl = jnp.where(colv == r[:, 4:5].astype(jnp.int32), r[:, 5:6] > 0.5,
+                   gl)
+    lc2 = jnp.where(active & ~gl, r[:, 6:7].astype(jnp.int32), lc)
+    lid_out_ref[:] = lc2
+
+    # ---- child histograms from the UPDATED leaf ids
+    match = (lc2 == cid_ref[:]).astype(jnp.float32)      # (Cg, K)
+    wh, wl = _split_weights_from_match(match, w3_ref[:])
+
+    xr = pltpu.repeat(x, bsub, axis=1)                   # (Cg, bsub*Fc)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
+    base = (lane // fc).astype(jnp.float32)
+    for s in range(bp // bsub):
+        oh = jnp.where(xr == base + jnp.float32(s * bsub),
+                       jnp.float32(1.0),
+                       jnp.float32(0.0)).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            oh, wh, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
+            oh, wl, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = slice(s * bsub * fc, (s + 1) * bsub * fc)
+        out_ref[rows, :] = out_ref[rows, :] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
+                                             "row_tile", "interpret",
+                                             "logical_cols"))
+def wave_partition_hist_pallas(X, leaf_id, w3, child_id, tbl,
+                               num_bins: int, bundled: bool = False,
+                               row_tile: int = 8192,
+                               interpret: bool = False,
+                               logical_cols: int = 0):
+    """Fused wave step: (new_leaf_id, (K, F, B, 3) child histograms).
+
+    X: (N, F) bins (or 4-bit packed with logical_cols set); leaf_id: (N,)
+    int32 BEFORE this wave's splits; w3: (N, 3) [g, h, mult];
+    child_id: (K,) target (smaller-child) leaves, -1 = inactive slot;
+    tbl: (L, 10) float32 per-leaf split table (ops/wave.py layout).
+    """
+    n, fdev = X.shape
+    fc = logical_cols or fdev
+    k = child_id.shape[0]
+    bp = _bin_pad(num_bins)
+    bsub, c = _tile_plan(n, fc, bp, row_tile)
+    pad = (-n) % c
+    lid2 = leaf_id[:, None]
+    w3f = w3.astype(jnp.float32)
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
+        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
+    nch = (n + pad) // c
+
+    kernel = functools.partial(_wave_fused_kernel, bp=bp, fc=fc, k=k,
+                               bsub=bsub, packed=bool(logical_cols),
+                               bundled=bundled)
+    newlid, flat = pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[
+            pl.BlockSpec((c, fdev), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 3), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(tbl.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((c, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(((n + pad), 1), jnp.int32),
+            jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(X, lid2, w3f, child_id[None, :], tbl)
+    h = flat.reshape(bp, fc, 3, k)[:num_bins]
+    return newlid[:n, 0], jnp.transpose(h, (3, 1, 0, 2))

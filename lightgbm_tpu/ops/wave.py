@@ -106,8 +106,9 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # hi/lo weight split (manual rounding — Mosaic's cast truncates).
     use_pallas_hist = (jax.default_backend() == "tpu"
                        and hist_dtype == jnp.float32
-                       and hist_mode in ("pallas", "pallas_t"))
+                       and hist_mode in ("pallas", "pallas_t", "pallas_f"))
     pallas_transposed = hist_mode == "pallas_t"
+    pallas_fused = hist_mode == "pallas_f"
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -177,8 +178,15 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
             On TPU the histogram half runs as the fused Pallas kernel
             (one-hot generated in VMEM, ops/pallas_wave.py) and the scan
-            below only partitions.
+            below only partitions; 'pallas_f' fuses BOTH halves into one
+            kernel — a single read of X per wave.
             """
+            if use_pallas_hist and pallas_fused:
+                from .pallas_wave import wave_partition_hist_pallas
+                return wave_partition_hist_pallas(
+                    X, leaf_id, w3, jnp.where(valid, small_id, -1), tbl,
+                    hist_bins, bundled=has_bundle,
+                    logical_cols=packed_cols)
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
                 else leaf_id.reshape(nch, c)
             wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3

@@ -159,10 +159,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 cache_hists=self.cache_hists, hist_mode=self.hist_mode,
                 chunk=int(config.tpu_wave_chunk))
         else:
-            if self.hist_mode == "pallas_t":
-                Log.fatal("tpu_histogram_mode=pallas_t is wave-only; the "
+            if self.hist_mode in ("pallas_t", "pallas_f"):
+                Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                           "voting-parallel learner's exact engine does not "
-                          "support it")
+                          "support it" % self.hist_mode)
             grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                                 self.params, config.max_depth,
                                 hist_mode=self.hist_mode,
@@ -287,10 +287,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             is_categorical=jnp.concatenate(
                 [jnp.asarray(train_data.is_categorical_arr, bool),
                  jnp.zeros(fpad, bool)]))
-        if self.hist_mode == "pallas_t":
-            Log.fatal("tpu_histogram_mode=pallas_t is wave-only; the "
+        if self.hist_mode in ("pallas_t", "pallas_f"):
+            Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                       "feature-parallel learner's exact engine does not "
-                      "support it")
+                      "support it" % self.hist_mode)
         grow = make_grow_fn(self.num_leaves, self.num_bins, self.meta,
                             self.params, config.max_depth,
                             hist_mode=self.hist_mode, hist_dtype=self.dtype,

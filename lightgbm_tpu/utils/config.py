@@ -317,9 +317,10 @@ class Config:
         "machine_list_file": ("str", ""),
         # tpu-native additions
         "tpu_use_dp": ("bool", False),
-        # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' —
-        # histogram kernel ('pallas' = exact-engine per-leaf kernel,
-        # 'pallas_t' = wave kernel with MXU-native transposed operands)
+        # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' |
+        # 'pallas_f' — histogram kernel ('pallas' = exact-engine per-leaf
+        # kernel, 'pallas_t' = wave kernel with MXU-native transposed
+        # operands, 'pallas_f' = fused partition+histogram wave kernel)
         "tpu_histogram_mode": ("str", "auto"),
         # 'auto' | 'exact' | 'wave' — growth schedule (ops/wave.py):
         # 'exact' is the reference's one-split-at-a-time leaf-wise order;

@@ -56,9 +56,10 @@ def test_pallas_t_data_parallel_constructs():
     assert bst.predict(X).shape == (1600,)
 
 
-def test_pallas_t_mode_plumbing():
-    """tpu_histogram_mode=pallas_t resolves to wave growth and trains
-    (falling back to the einsum path off-TPU); exact growth rejects it."""
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f"])
+def test_pallas_wave_mode_plumbing(mode):
+    """Wave-only pallas modes resolve to wave growth and train (falling
+    back to the einsum path off-TPU); exact growth rejects them."""
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.log import LightGBMError
 
@@ -66,7 +67,7 @@ def test_pallas_t_mode_plumbing():
     X = rng.normal(size=(1200, 6))
     y = (X[:, 0] > 0).astype(np.float64)
     params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
-              "tpu_histogram_mode": "pallas_t"}
+              "tpu_histogram_mode": mode}
     bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
                     num_boost_round=3)
     assert bst._gbdt.learner.growth == "wave"
@@ -96,3 +97,67 @@ def test_kernel_packed_matches_oracle(layout):
             jnp.asarray(packed.T), jnp.asarray(leaf_id), jnp.asarray(w3),
             jnp.asarray(cid), b, interpret=True, logical_cols=X.shape[1])
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
+
+
+def _route_numpy(X, leaf_id, tbl):
+    """Numpy replica of the wave partition routing (ops/wave.py)."""
+    r = tbl[np.clip(leaf_id, 0, tbl.shape[0] - 1)]
+    r = np.where((leaf_id >= 0)[:, None], r, 0.0)
+    active = r[:, 0] > 0.5
+    cj = r[:, 1].astype(np.int32)
+    colv = X[np.arange(len(X)), np.clip(cj, 0, X.shape[1] - 1)].astype(
+        np.int32)
+    thr = r[:, 2].astype(np.int32)
+    cat = r[:, 3] > 0.5
+    gl = np.where(cat, colv == thr, colv <= thr)
+    gl = np.where(colv == r[:, 4].astype(np.int32), r[:, 5] > 0.5, gl)
+    return np.where(active & ~gl, r[:, 6].astype(np.int32), leaf_id)
+
+
+def test_fused_kernel_matches_oracle():
+    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas
+
+    X, leaf_id, w3, cid, b = _data(n=2500, f=7, b=14, k=5, seed=9)
+    L = 16
+    rng = np.random.default_rng(10)
+    leaf_id = rng.integers(0, 8, size=len(X)).astype(np.int32)
+    tbl = np.zeros((L, 10), np.float32)
+    for leaf in (1, 3, 5):                  # three leaves split this wave
+        tbl[leaf] = [1, rng.integers(0, 7), rng.integers(0, 14), 0,
+                     0, rng.integers(0, 2), 8 + leaf, 0, 0, 0]
+
+    want_lid = _route_numpy(X, leaf_id, tbl)
+    want_hist = np.array(wave_histogram_reference(
+        jnp.asarray(X), jnp.asarray(want_lid), jnp.asarray(w3),
+        jnp.asarray(cid), b))
+    want_hist[np.asarray(cid) < 0] = 0.0
+
+    got_lid, got_hist = wave_partition_hist_pallas(
+        jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
+    np.testing.assert_allclose(np.asarray(got_hist), want_hist,
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_fused_kernel_packed():
+    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas
+
+    X, leaf_id, w3, cid, b = _data(n=2000, f=9, b=15, seed=11)
+    rng = np.random.default_rng(12)
+    leaf_id = rng.integers(0, 6, size=len(X)).astype(np.int32)
+    tbl = np.zeros((8, 10), np.float32)
+    tbl[2] = [1, 4, 7, 0, 0, 1, 6, 0, 0, 0]
+    want_lid = _route_numpy(X, leaf_id, tbl)
+    want_hist = np.array(wave_histogram_reference(
+        jnp.asarray(X), jnp.asarray(want_lid), jnp.asarray(w3),
+        jnp.asarray(cid), b))
+    want_hist[np.asarray(cid) < 0] = 0.0
+    packed = pack4_host(X)
+    got_lid, got_hist = wave_partition_hist_pallas(
+        jnp.asarray(packed), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True,
+        logical_cols=X.shape[1])
+    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
+    np.testing.assert_allclose(np.asarray(got_hist), want_hist,
+                               rtol=5e-4, atol=5e-4)

@@ -47,7 +47,7 @@ def main():
     # ---- end-to-end engines at the 255-leaf recipe
     from tools.bench_modes import make_data, run
     X, y = make_data(n)
-    for mode in ("onehot", "pallas", "pallas_t"):
+    for mode in ("onehot", "pallas", "pallas_t", "pallas_f"):
         t0 = time.time()
         try:
             dt, auc = run(X, y, mode)
