@@ -40,17 +40,18 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
-def test_pallas_t_data_parallel_constructs():
-    """tree_learner=data + pallas_t must reach the mesh wave branch (the
-    base constructor's exact-engine fallback maps pallas_t to onehot
-    instead of crashing) and train."""
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f"])
+def test_pallas_wave_data_parallel_constructs(mode):
+    """tree_learner=data + a wave-only pallas mode must reach the mesh
+    wave branch (the base constructor's exact-engine fallback maps these
+    modes to onehot instead of crashing) and train."""
     import lightgbm_tpu as lgb
 
     rng = np.random.default_rng(2)
     X = rng.normal(size=(1600, 6))
     y = (X[:, 0] > 0).astype(np.float64)
     params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
-              "tree_learner": "data", "tpu_histogram_mode": "pallas_t"}
+              "tree_learner": "data", "tpu_histogram_mode": mode}
     bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
                     num_boost_round=2)
     assert bst.predict(X).shape == (1600,)
