@@ -1,4 +1,4 @@
-"""Worker for the 2-process DCN test (tests/test_multiprocess.py).
+"""Worker for the multi-process DCN tests (tests/test_multiprocess.py).
 
 Each process: jax.distributed.initialize over a localhost coordinator
 (the TPU-native replacement for machine_list_file + socket handshake,

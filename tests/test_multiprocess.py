@@ -1,13 +1,13 @@
-"""Multi-process (DCN) data-parallel training — 2 REAL processes.
+"""Multi-process (DCN) data-parallel training with REAL OS processes.
 
 The reference proves its distributed path by running MPI in CI
 (.travis.yml:45-52); the TPU-native analog is jax.distributed over a
-localhost coordinator: two OS processes, each with 2 virtual CPU devices,
-form one 4-device global mesh.  Histograms psum ACROSS the process
-boundary (the DCN hop of a multi-host pod), bin mappers are constructed
-distributed via JaxProcessComm, and both processes must emerge with
-identical trees — which must also equal the single-process oracle on the
-concatenated data.
+localhost coordinator: N OS processes, each with 2 virtual CPU devices,
+form one 2N-device global mesh (N=2 and N=4 below).  Histograms psum
+ACROSS the process boundaries (the DCN hops of a multi-host pod), bin
+mappers are constructed distributed via JaxProcessComm, and every
+process must emerge with identical trees — which must also equal the
+single-process oracle on the concatenated data.
 """
 import json
 import os
@@ -30,27 +30,40 @@ def _free_port():
     return port
 
 
-def test_two_process_data_parallel_training():
+def _run_workers(nproc):
     coordinator = "127.0.0.1:%d" % _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own device count
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # worker output goes to FILES: a failing rank can dump >64 KB
+    # (pipe-buffer size) of tracebacks, which with stdout=PIPE would
+    # block it while the parent waits on another rank — a 540 s stall
+    # that also loses the diagnostics
+    import tempfile
+    logs = [tempfile.NamedTemporaryFile("w+", suffix="_r%d.log" % r,
+                                        delete=False)
+            for r in range(nproc)]
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(HERE, "mp_worker.py"),
-         coordinator, "2", str(r)],
-        env=env, cwd=REPO, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True) for r in range(2)]
-    outs = []
+         coordinator, str(nproc), str(r)],
+        env=env, cwd=REPO, stdout=logs[r], stderr=subprocess.STDOUT,
+        text=True) for r in range(nproc)]
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
-            outs.append(out)
+            p.wait(timeout=540)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-                p.communicate()
+                p.wait()
+    outs = []
+    for f in logs:
+        f.flush()
+        f.seek(0)
+        outs.append(f.read())
+        f.close()
+        os.unlink(f.name)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, "worker failed:\n%s" % out[-3000:]
     results = {}
@@ -59,7 +72,21 @@ def test_two_process_data_parallel_training():
                 if ln.startswith("MPRESULT ")][-1]
         r = json.loads(line[len("MPRESULT "):])
         results[r["rank"]] = r
-    assert set(results) == {0, 1}
+    assert set(results) == set(range(nproc))
+    return results
+
+
+def test_four_process_ranks_agree():
+    """4 OS processes x 2 virtual devices = an 8-device global mesh with
+    three DCN hops; every rank must emerge with the identical model."""
+    results = _run_workers(4)
+    trees = [results[r]["trees"] for r in range(4)]
+    assert all(t == trees[0] for t in trees[1:])
+    assert all(t["num_leaves"] > 4 for t in trees[0])
+
+
+def test_two_process_data_parallel_training():
+    results = _run_workers(2)
 
     # both processes must hold the identical model
     t0, t1 = results[0]["trees"], results[1]["trees"]
