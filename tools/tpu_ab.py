@@ -47,15 +47,17 @@ def main():
     # ---- end-to-end engines at the 255-leaf recipe
     from tools.bench_modes import make_data, run
     X, y = make_data(n)
-    for mode in ("onehot", "pallas", "pallas_t", "pallas_f"):
+    combos = [("onehot", 32), ("onehot", 64), ("pallas", 32),
+              ("pallas_t", 32), ("pallas_f", 32), ("pallas_f", 64)]
+    for mode, width in combos:
         t0 = time.time()
         try:
-            dt, auc = run(X, y, mode)
-            ln = ("    engine %-8s: %.3f s/iter (%.2f it/s) auc=%.4f "
-                  "[wall %.0fs]" % (mode, dt, 1.0 / dt, auc,
-                                    time.time() - t0))
+            dt, auc = run(X, y, mode, wave_width=width)
+            ln = ("    engine %-8s W=%-2d: %.3f s/iter (%.2f it/s) "
+                  "auc=%.4f [wall %.0fs]"
+                  % (mode, width, dt, 1.0 / dt, auc, time.time() - t0))
         except Exception as e:  # record, keep going
-            ln = "    engine %-8s: FAILED (%s)" % (mode, e)
+            ln = "    engine %-8s W=%-2d: FAILED (%s)" % (mode, width, e)
         lines.append(ln)
         print(ln, flush=True)
 
