@@ -1,0 +1,62 @@
+"""Config-system robustness: alias canonicalization, string round-trips,
+and type coercion over randomized inputs (config.h:360-489 semantics).
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.config import (ALIAS_TABLE, Config,
+                                       key_alias_transform,
+                                       param_dict_to_str)
+
+
+def test_every_alias_canonicalizes():
+    for alias, canonical in ALIAS_TABLE.items():
+        out = key_alias_transform({alias: "7"})
+        assert canonical in out, (alias, canonical)
+        assert out[canonical] == "7"
+
+
+def test_canonical_key_wins_over_alias():
+    out = key_alias_transform({"num_iterations": 50, "num_trees": 99})
+    assert out["num_iterations"] == 50
+
+
+def test_param_str_roundtrip_fuzz():
+    rng = np.random.default_rng(0)
+    keys = ["num_leaves", "learning_rate", "max_bin", "bagging_fraction",
+            "min_data_in_leaf", "lambda_l2", "verbose"]
+    for _ in range(25):
+        params = {}
+        for k in keys:
+            if rng.random() < 0.5:
+                continue
+            params[k] = (int(rng.integers(1, 100)) if k != "learning_rate"
+                         and k != "bagging_fraction" and k != "lambda_l2"
+                         else round(float(rng.random()), 4))
+        if "num_leaves" in params:
+            params["num_leaves"] = max(2, params["num_leaves"])
+        if "bagging_fraction" in params:
+            params["bagging_fraction"] = max(0.1,
+                                             params["bagging_fraction"])
+        s = param_dict_to_str(params)
+        parsed = {}
+        for pair in s.split():
+            k, v = pair.split("=", 1)
+            parsed[k] = v
+        cfg = Config(parsed)
+        for k, v in params.items():
+            assert float(getattr(cfg, k)) == pytest.approx(float(v)), k
+
+
+def test_vector_params_parse_both_separators():
+    a = Config({"ndcg_eval_at": "1,3,5", "verbose": -1})
+    b = Config({"ndcg_eval_at": "1 3 5", "verbose": -1})
+    c = Config({"ndcg_eval_at": [1, 3, 5], "verbose": -1})
+    assert a.ndcg_eval_at == b.ndcg_eval_at == c.ndcg_eval_at == [1, 3, 5]
+
+
+def test_unknown_param_raises_on_cli_path():
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        key_alias_transform({"definitely_not_a_param": 1},
+                            raise_unknown=True)
