@@ -172,9 +172,14 @@ int copy_str(PyObject* r, int64_t buffer_len, int64_t* out_len,
   return 0;
 }
 
-// copy a Python list[str] into caller-pre-allocated char** (the
-// reference strcpy's each name without a size, GetEvalNames/GetFeatureNames
-// contract — callers allocate generous fixed-width slots)
+// copy a Python list[str] into caller-pre-allocated char** — the
+// GetEvalNames/GetFeatureNames contract of this vintage: the caller
+// allocates fixed-width slots of at least kNameSlotWidth bytes each (the
+// reference's Python wrapper uses 255-byte buffers and its C side strcpy's
+// with no bound). We keep the ABI but cap each write at kNameSlotWidth
+// bytes including the NUL, so an under-allocating caller gets a truncated
+// name instead of a silent overflow.
+static const size_t kNameSlotWidth = 255;
 int copy_strs(PyObject* r, int* out_len, char** out_strs) {
   if (!r) return -1;
   if (!PyList_Check(r)) {
@@ -192,7 +197,9 @@ int copy_strs(PyObject* r, int* out_len, char** out_strs) {
         Py_DECREF(r);
         return -1;
       }
-      std::strcpy(out_strs[i], s);
+      size_t len = strnlen(s, kNameSlotWidth - 1);
+      std::memcpy(out_strs[i], s, len);
+      out_strs[i][len] = '\0';
     }
   }
   Py_DECREF(r);

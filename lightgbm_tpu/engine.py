@@ -101,6 +101,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     booster.best_iteration = -1
     finished_iteration = num_boost_round
+    evaluation_result_list = []  # stays empty when num_boost_round == 0
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(model=booster, params=params,

@@ -178,7 +178,11 @@ class SerialTreeLearner:
             Log.fatal("tpu_histogram_mode=%s requires tpu_growth=wave "
                       "(this kernel is wave-only)" % hist_mode)
         self.growth = growth
-        self.wave_width = resolve_wave_width(config, self.num_leaves)
+        # wave width only matters (and is only validated) under wave
+        # growth — an exact-growth config with a leftover garbage
+        # tpu_wave_width must keep training (ADVICE r2).
+        self.wave_width = (resolve_wave_width(config, self.num_leaves)
+                           if growth == "wave" else 1)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
         # every device column fits a nibble, store TWO columns per byte in
         # HBM; the wave engine unpacks per chunk in-scan, so the bin
@@ -206,12 +210,17 @@ class SerialTreeLearner:
             if psum_axis is not None:
                 reasons.append("the serial (single-shard) learner")
             if not can_pack4(bins_per_col):
-                reasons.append("max_bin<=15 on every column")
+                reasons.append("at most 16 bins per column (max_bin<=15 "
+                               "plus the reserved zero/missing bin)")
             Log.warning("tpu_bin_pack=true ignored: packing requires %s",
                         " and ".join(reasons))
         if int(config.tpu_wave_chunk) <= 0:
             Log.fatal("tpu_wave_chunk must be positive, got %s",
                       config.tpu_wave_chunk)
+        elif growth == "wave" and int(config.tpu_wave_chunk) < 256:
+            Log.warning("tpu_wave_chunk=%d is below the engine minimum; "
+                        "the wave sweep uses 256-row chunks instead",
+                        int(config.tpu_wave_chunk))
         # ---- device upload (row-padded to a quantum so nearby dataset
         # sizes land on the same compiled shape; pad rows carry zero
         # row_mult and change nothing)

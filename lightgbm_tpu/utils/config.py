@@ -335,12 +335,14 @@ class Config:
         "tpu_wave_width": ("int", -1),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
-        # (VMEM-residency vs scan-overhead tradeoff on TPU)
+        # (VMEM-residency vs scan-overhead tradeoff on TPU; engine
+        # minimum 256 — smaller values are clamped with a warning)
         "tpu_wave_chunk": ("int", 16384),
         # 'auto' | 'true' | 'false' — 4-bit bin packing (ops/pack.py, the
-        # dense_nbits_bin.hpp:37 analog): when every device column fits a
-        # nibble (max_bin<=15), two columns share a byte in HBM and the
-        # wave engine unpacks per chunk.  auto = pack whenever eligible.
+        # dense_nbits_bin.hpp:37 analog): when every device column holds at
+        # most 16 bins (max_bin<=15 plus the reserved zero/missing bin),
+        # two columns share a byte in HBM and the wave engine unpacks per
+        # chunk.  auto = pack whenever eligible.
         "tpu_bin_pack": ("str", "auto"),
     }
 

@@ -153,7 +153,7 @@ def test_c_abi_full_surface(tmp_path):
     names = [b"f%d" % i for i in range(F)]
     arr = (ctypes.c_char_p * F)(*names)
     _check(lib, lib.LGBM_DatasetSetFeatureNames(ds, arr, ctypes.c_int(F)))
-    bufs = [ctypes.create_string_buffer(64) for _ in range(F)]
+    bufs = [ctypes.create_string_buffer(255) for _ in range(F)]
     outp = (ctypes.c_char_p * F)(*[ctypes.cast(b, ctypes.c_char_p)
                                    for b in bufs])
     n_names = ctypes.c_int()
@@ -210,7 +210,7 @@ def test_c_abi_full_surface(tmp_path):
     # eval/feature name lists
     elen = ctypes.c_int()
     nslots = max(F, 8)
-    ebufs = [ctypes.create_string_buffer(64) for _ in range(nslots)]
+    ebufs = [ctypes.create_string_buffer(255) for _ in range(nslots)]
     eoutp = (ctypes.c_char_p * nslots)(*[ctypes.cast(b, ctypes.c_char_p)
                                          for b in ebufs])
     _check(lib, lib.LGBM_BoosterGetEvalNames(bst, ctypes.byref(elen),

@@ -295,3 +295,26 @@ def test_reset_parameter_callback_skips_unchanged():
     constant = fit([lgb.reset_parameter(lambda_l2=lambda i: 1.0)])
     np.testing.assert_allclose(plain.predict(X), constant.predict(X),
                                rtol=1e-12)
+
+
+def test_zero_boost_rounds():
+    # num_boost_round=0 must return an empty booster, not NameError
+    # (reference engine.py handles 0 rounds).
+    X, y = make_binary(n=200)
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=0,
+                    verbose_eval=False)
+    assert bst.current_iteration() == 0
+    assert dict(bst.best_score) == {}
+
+
+def test_exact_growth_ignores_bad_wave_width():
+    # ADVICE r2: exact growth never uses the wave width, so a garbage
+    # tpu_wave_width must not abort training.
+    X, y = make_binary(n=300)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "tpu_growth": "exact", "tpu_wave_width": 0,
+                     "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=3,
+                    verbose_eval=False)
+    assert bst.current_iteration() == 3
