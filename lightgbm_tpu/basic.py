@@ -40,12 +40,106 @@ def _data_from_any(data, label=None):
     try:
         import pandas as pd
         if isinstance(data, pd.DataFrame):
-            return data.values.astype(np.float64), label
+            # kept as a frame until construct(): category columns must be
+            # coded against the *reference* dataset's category lists, and
+            # the reference may be attached after __init__ (set_reference)
+            return data, label
         if label is not None and isinstance(label, (pd.Series, pd.DataFrame)):
             label = label.values
     except ImportError:
         pass
     return np.asarray(data, dtype=np.float64), label
+
+
+_PANDAS_OK_KINDS = "biuf"   # bool / int / uint / float columns train directly
+
+
+def _is_pandas_frame(data) -> bool:
+    try:
+        import pandas as pd
+    except ImportError:
+        return False
+    return isinstance(data, pd.DataFrame)
+
+
+def _data_from_pandas(data, feature_name, categorical_feature,
+                      pandas_categorical):
+    """Code category-dtype columns and resolve auto names — the semantics of
+    the reference's pandas path (python-package/lightgbm/basic.py:224-291).
+
+    Train call: ``pandas_categorical=None`` -> the per-column category lists
+    are recorded from ``data`` and returned.  Valid/predict call: pass the
+    train-time lists; each category column is re-coded against them so the
+    integer codes agree across datasets even when the frames saw different
+    category orders.  Returns ``(matrix, feature_name, categorical_feature,
+    pandas_categorical)``.
+
+    NaN/unseen categories code to -1, kept as-is: this vintage of the
+    reference counts -1 as an ordinary category at train time
+    (src/io/bin.cpp:242-255 has no negative filter) and maps values absent
+    from the bin map to the last bin at predict (bin.h:435-439) — our
+    binning does the same, so -1 handling is parity, not an accident.
+    """
+    cat_cols = [c for c in data.columns
+                if str(data[c].dtype) == "category"]
+    if pandas_categorical is None:          # train dataset records the maps
+        pandas_categorical = [list(data[c].cat.categories) for c in cat_cols]
+    else:                                   # valid/predict aligns to train
+        if len(cat_cols) != len(pandas_categorical):
+            raise LightGBMError(
+                "train and valid dataset categorical_feature do not match.")
+    if cat_cols:
+        data = data.copy()      # never alter the caller's frame
+        for c, train_cats in zip(cat_cols, pandas_categorical):
+            if list(data[c].cat.categories) != list(train_cats):
+                data[c] = data[c].cat.set_categories(train_cats)
+            data[c] = data[c].cat.codes
+    if categorical_feature is not None:
+        if categorical_feature == "auto":
+            categorical_feature = [str(c) for c in cat_cols]
+        else:
+            categorical_feature = (list(categorical_feature)
+                                   + [str(c) for c in cat_cols])
+    if feature_name == "auto":
+        feature_name = [str(c) for c in data.columns]
+    bad = [str(c) for c, dt in zip(data.columns, data.dtypes)
+           if getattr(dt, "kind", "O") not in _PANDAS_OK_KINDS]
+    if bad:
+        raise LightGBMError(
+            "DataFrame.dtypes for data must be int, float or bool; found "
+            "unsupported dtypes in fields: " + ", ".join(bad))
+    return (data.values.astype(np.float64), feature_name,
+            categorical_feature, pandas_categorical)
+
+
+def _json_default_numpy(obj):
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError("Cannot serialize %s in pandas_categorical"
+                    % type(obj).__name__)
+
+
+def _dump_pandas_categorical(pandas_categorical) -> str:
+    import json
+    return json.dumps(pandas_categorical, default=_json_default_numpy)
+
+
+def _parse_pandas_categorical(model_str: str):
+    """Read the trailing ``pandas_categorical:`` line a saved model carries
+    (reference appends it after the model text, basic.py:283-291)."""
+    import json
+    idx = model_str.rfind("pandas_categorical:")
+    if idx < 0:
+        return None
+    line = model_str[idx + len("pandas_categorical:"):].splitlines()[0]
+    try:
+        return json.loads(line)
+    except ValueError:
+        return None
 
 
 class Dataset:
@@ -70,6 +164,7 @@ class Dataset:
         if max_bin is not None:
             self.params.setdefault("max_bin", max_bin)
         self.free_raw_data = free_raw_data
+        self.pandas_categorical = None
         self._handle: Optional[TrainingData] = None
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
@@ -92,9 +187,19 @@ class Dataset:
                                                       reference=ref_td)
         else:
             from .io.sparse import SparseColumns
-            sparse = isinstance(self.data, SparseColumns)
-            data = self.data if sparse else np.asarray(self.data,
-                                                      dtype=np.float64)
+            if self.reference is not None:
+                self.reference.construct()
+            data = self.data
+            if _is_pandas_frame(data):
+                ref_pc = (self.reference.pandas_categorical
+                          if self.reference is not None else None)
+                data, self.feature_name, self.categorical_feature, \
+                    self.pandas_categorical = _data_from_pandas(
+                        data, self.feature_name, self.categorical_feature,
+                        ref_pc)
+                self.data = data
+            sparse = isinstance(data, SparseColumns)
+            data = data if sparse else np.asarray(data, dtype=np.float64)
             if self.feature_name not in (None, "auto"):
                 feature_names = list(self.feature_name)
             if self.categorical_feature not in (None, "auto"):
@@ -120,10 +225,8 @@ class Dataset:
                                 % (c, feature_names or "auto Column_<i>"))
                     else:
                         cat.append(int(c))
-            ref_td = None
-            if self.reference is not None:
-                self.reference.construct()
-                ref_td = self.reference._handle
+            ref_td = (self.reference._handle       # constructed above
+                      if self.reference is not None else None)
             if sparse:
                 self._handle = TrainingData.from_csc(
                     data, label=self.label, config=cfg,
@@ -342,12 +445,14 @@ class Booster:
         self._valid_sets: List[Dataset] = []
         self.name_valid_sets: List[str] = []
         self._network = False
+        self.pandas_categorical = None
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be Dataset instance, met %s"
                                 % type(train_set).__name__)
             cfg = Config(self.params)
             train_set._update_params(self.params).construct()
+            self.pandas_categorical = train_set.pandas_categorical
             objective = create_objective(cfg.objective, cfg)
             if objective is not None:
                 objective.init(train_set._handle.metadata,
@@ -388,6 +493,7 @@ class Booster:
         self._cfg = Config(self.params)
         self._gbdt = GBDT(self._cfg)
         self._gbdt.load_model_from_string(model_str)
+        self.pandas_categorical = _parse_pandas_categorical(model_str)
         self._train_set = None
 
     # ------------------------------------------------------------- training
@@ -601,6 +707,9 @@ class Booster:
             parsed = _parser.parse_file(data, has_header=data_has_header)
             mat = parsed.features
         else:
+            if _is_pandas_frame(data):
+                data, _, _, _ = _data_from_pandas(
+                    data, None, None, self.pandas_categorical)
             mat, _ = _data_from_any(data)
             from .io.sparse import SparseColumns, iter_dense_row_chunks
             if isinstance(mat, SparseColumns):
@@ -623,11 +732,16 @@ class Booster:
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         """Write the model text file (loadable by the reference too)."""
         self._gbdt.save_model_to_file(filename, num_iteration)
+        with open(filename, "a") as f:
+            f.write("\npandas_categorical:%s\n"
+                    % _dump_pandas_categorical(self.pandas_categorical))
         return self
 
     def model_to_string(self, num_iteration: int = -1) -> str:
         """Model in the reference-compatible text format."""
-        return self._gbdt.save_model_to_string(num_iteration)
+        return (self._gbdt.save_model_to_string(num_iteration)
+                + "\npandas_categorical:%s\n"
+                % _dump_pandas_categorical(self.pandas_categorical))
 
     def dump_model(self, num_iteration: int = -1) -> dict:
         """Model as a JSON-compatible dict."""

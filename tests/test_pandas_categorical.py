@@ -1,0 +1,114 @@
+"""pandas category-dtype parity — the reference's _data_from_pandas
+semantics (python-package/lightgbm/basic.py:224-291): category columns are
+coded, categorical_feature auto-populates, valid/predict frames re-code
+against the train-time category lists, and the lists ride the model file.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+PARAMS = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+          "min_data_in_leaf": 5, "tpu_growth": "exact"}
+
+
+def make_frame(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    cats = np.array(["red", "green", "blue", "violet"])
+    c = rng.integers(0, 4, size=n)
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    y = ((c == 2).astype(float) * 1.5 + x0 > 0.5).astype(np.float64)
+    df = pd.DataFrame({
+        "num0": x0,
+        "color": pd.Categorical.from_codes(c, categories=list(cats)),
+        "num1": x1,
+    })
+    return df, c, y
+
+
+def test_category_frame_matches_int_codes():
+    df, codes, y = make_frame()
+    bst_df = lgb.train(PARAMS, lgb.Dataset(df, label=y),
+                       num_boost_round=12, verbose_eval=False)
+    X = np.column_stack([df["num0"].values, codes.astype(np.float64),
+                         df["num1"].values])
+    bst_mat = lgb.train(PARAMS, lgb.Dataset(X, label=y,
+                                            categorical_feature=[1]),
+                        num_boost_round=12, verbose_eval=False)
+    # identical training decisions: same trees modulo feature names
+    s_df = bst_df.model_to_string()
+    s_mat = bst_mat.model_to_string()
+    trees_df = s_df[s_df.index("Tree="):s_df.index("feature importances")]
+    trees_mat = s_mat[s_mat.index("Tree="):s_mat.index("feature importances")]
+    assert trees_df == trees_mat
+    np.testing.assert_allclose(bst_df.predict(df), bst_mat.predict(X),
+                               rtol=1e-12)
+
+
+def test_valid_frame_realigns_category_order():
+    df, codes, y = make_frame()
+    # a valid frame whose categories arrive in a different order must be
+    # re-coded against the train categories, not its own
+    df_v, codes_v, y_v = make_frame(seed=9)
+    shuffled = ["violet", "blue", "red", "green"]
+    df_v["color"] = df_v["color"].cat.reorder_categories(shuffled)
+    train = lgb.Dataset(df, label=y)
+    valid = lgb.Dataset(df_v, label=y_v, reference=train)
+    evals = {}
+    lgb.train(PARAMS, train, num_boost_round=10, valid_sets=[valid],
+              evals_result=evals, verbose_eval=False)
+    # and the same data int-coded with the TRAIN order gives the same eval
+    X = np.column_stack([df["num0"].values, codes.astype(np.float64),
+                         df["num1"].values])
+    Xv = np.column_stack([df_v["num0"].values, codes_v.astype(np.float64),
+                          df_v["num1"].values])
+    tr = lgb.Dataset(X, label=y, categorical_feature=[1])
+    evals2 = {}
+    lgb.train(PARAMS, tr, num_boost_round=10,
+              valid_sets=[lgb.Dataset(Xv, label=y_v, reference=tr)],
+              evals_result=evals2, verbose_eval=False)
+    np.testing.assert_allclose(evals["valid_0"]["binary_logloss"],
+                               evals2["valid_0"]["binary_logloss"],
+                               rtol=1e-9)
+
+
+def test_predict_applies_train_categories_after_roundtrip(tmp_path):
+    df, codes, y = make_frame()
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y),
+                    num_boost_round=10, verbose_eval=False)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.pandas_categorical == [["red", "green", "blue", "violet"]]
+    # a predict frame with reordered categories must map back to train codes
+    df_p = df.copy()
+    df_p["color"] = df_p["color"].cat.reorder_categories(
+        ["blue", "violet", "green", "red"])
+    np.testing.assert_allclose(loaded.predict(df_p), bst.predict(df),
+                               rtol=1e-12)
+
+
+def test_mismatched_cat_columns_raise():
+    df, _, y = make_frame()
+    train = lgb.Dataset(df, label=y)
+    df_v = df.drop(columns=["color"]).assign(extra=1.0)
+    valid = lgb.Dataset(df_v, label=y, reference=train)
+    with pytest.raises(LightGBMError, match="do not match"):
+        lgb.train(PARAMS, train, num_boost_round=2, valid_sets=[valid],
+                  verbose_eval=False)
+
+
+def test_object_dtype_rejected():
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": ["x", "y"]})
+    with pytest.raises(LightGBMError, match="int, float or bool"):
+        lgb.Dataset(df, label=np.array([0.0, 1.0])).construct()
+
+
+def test_feature_names_from_frame_columns():
+    df, _, y = make_frame(n=200)
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=2,
+                    verbose_eval=False)
+    assert bst.feature_name() == ["num0", "color", "num1"]
