@@ -190,12 +190,15 @@ class TrainingData:
             if (config.enable_bundle and len(self.used_feature_idx) > 1
                     and config.tree_learner not in ("feature",
                                                     "feature_parallel")):
+                # uint16 is enough for bin ids (max_bin caps below 65536)
+                # and keeps the (S, F) sample ~8x smaller than int64 —
+                # at Bosch shape (200k x 968) that is 0.39 GB vs 1.55 GB
                 binned_sample = np.empty(
-                    (total_sample, len(self.used_feature_idx)), np.int64)
+                    (total_sample, len(self.used_feature_idx)), np.uint16)
                 for i, r in enumerate(self.used_feature_idx):
                     mapper = self.bin_mappers[r]
                     col = np.full(total_sample,
-                                  self.default_bin_arr[i], np.int64)
+                                  self.default_bin_arr[i], np.uint16)
                     spos, sv = col_sample_cache[r]
                     if len(spos):
                         col[spos] = mapper.value_to_bin(sv)
@@ -204,6 +207,7 @@ class TrainingData:
                     binned_sample, self.num_bin_arr, self.default_bin_arr,
                     config.max_conflict_rate, config.min_data_in_leaf,
                     self.num_data)
+                del binned_sample   # before the (N, G) product allocates
                 if self.bundle is not None:
                     Log.info("EFB bundled %d features into %d groups",
                              len(self.used_feature_idx),
@@ -375,7 +379,7 @@ class TrainingData:
                                                 "feature_parallel")):
             binned_sample = np.stack(
                 [self.bin_mappers[r].value_to_bin(sample[:, r])
-                 for r in self.used_feature_idx], axis=1)
+                 .astype(np.uint16) for r in self.used_feature_idx], axis=1)
             self.bundle = find_feature_groups(
                 binned_sample, self.num_bin_arr, self.default_bin_arr,
                 config.max_conflict_rate, config.min_data_in_leaf,
@@ -445,7 +449,8 @@ class TrainingData:
             if comm.rank == 0:
                 binned_sample = np.stack(
                     [self.bin_mappers[r].value_to_bin(sample[:, r])
-                     for r in self.used_feature_idx], axis=1)
+                     .astype(np.uint16) for r in self.used_feature_idx],
+                    axis=1)
                 layout = find_feature_groups(
                     binned_sample, self.num_bin_arr, self.default_bin_arr,
                     config.max_conflict_rate, config.min_data_in_leaf,

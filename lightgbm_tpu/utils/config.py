@@ -412,6 +412,10 @@ class Config:
     def check_param_conflict(self) -> None:
         if self.num_leaves < 2:
             Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if self.max_bin < 2 or self.max_bin > 65535:
+            # bin ids must fit the uint16 stores (io/dataset.py binned
+            # matrices and the EFB conflict sample)
+            Log.fatal("max_bin must be in [2, 65535], got %d", self.max_bin)
         if self.is_pre_partition and self.num_machines <= 1:
             self.is_pre_partition = False
         if self.max_depth > 0:
