@@ -122,7 +122,7 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  voting_k: int = 0, num_voting_machines: int = 1,
                  bundle: BundleArrays = None, group_bins: int = 0,
                  row_capacities: tuple = (), cache_hists: bool = True,
-                 seg_after: int = 15):
+                 seg_after: int = 15, packed_cols: int = 0):
     """Bind `meta`/`bundle` onto the shared memoized grow program.
 
     The heavy lifting lives in `make_grow_core`, which is cached on the
@@ -135,7 +135,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           hist_mode, hist_dtype, psum_axis, feature_axis,
                           voting_k, num_voting_machines,
                           bundle is not None, group_bins,
-                          row_capacities, cache_hists, seg_after)
+                          row_capacities, cache_hists, seg_after,
+                          packed_cols)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -159,7 +160,7 @@ def make_grow_core(num_leaves: int, num_bins: int,
                    voting_k: int = 0, num_voting_machines: int = 1,
                    has_bundle: bool = False, group_bins: int = 0,
                    row_capacities: tuple = (), cache_hists: bool = True,
-                   seg_after: int = 15):
+                   seg_after: int = 15, packed_cols: int = 0):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -248,19 +249,26 @@ def make_grow_core(num_leaves: int, num_bins: int,
             def run(_):
                 _, _, blk, valid = seg_block(order, start, count, cap)
                 return gathered_histogram(X, g, h, row_mult, blk, valid,
-                                          hist_bins, hist_mode)
+                                          hist_bins, hist_mode,
+                                          logical_cols=packed_cols)
             return run
         return lax.switch(seg_tier(count),
                           [branch(c) for c in row_capacities], None)
 
+    if packed_cols and hist_mode == "pallas":
+        raise ValueError("4-bit packing is not supported by the pallas "
+                         "exact-growth kernel (use onehot/scatter)")
     if hist_mode == "onehot":
-        hist_fn = functools.partial(leaf_histogram_onehot, num_bins=hist_bins)
+        hist_fn = functools.partial(leaf_histogram_onehot,
+                                    num_bins=hist_bins,
+                                    logical_cols=packed_cols)
     elif hist_mode == "pallas":
         from .pallas_hist import leaf_histogram_pallas
         hist_fn = functools.partial(leaf_histogram_pallas, num_bins=hist_bins)
     elif hist_mode == "scatter":
         hist_fn = functools.partial(leaf_histogram_scatter,
-                                    num_bins=hist_bins)
+                                    num_bins=hist_bins,
+                                    logical_cols=packed_cols)
     else:
         from ..utils.log import Log
         Log.fatal("Unknown tpu_histogram_mode %s "
@@ -303,7 +311,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                     idx = compact_rows_topk(mask, c)
                 valid = jnp.arange(c, dtype=jnp.int32) < count
                 return gathered_histogram(X, g, h, row_mult, idx, valid,
-                                          hist_bins, hist_mode)
+                                          hist_bins, hist_mode,
+                                          logical_cols=packed_cols)
             return run
 
         return lax.switch(tier, [tier_branch(c) for c in row_capacities],
@@ -498,10 +507,22 @@ def make_grow_core(num_leaves: int, num_bins: int,
                 return jnp.where(in_range, gcol - goff + bundle.bin_adj[f],
                                  fdefault)
 
+            def fetch_col_of(Xs, j):
+                """Device column j of Xs as int32 bins — nibble-extracted
+                when the store is 4-bit packed (ops/pack.py split-half:
+                logical j < Fh lives in col j's low nibble, j >= Fh in
+                col j-Fh's high nibble)."""
+                if not packed_cols:
+                    return jnp.take(Xs, j, axis=-1).astype(jnp.int32)
+                fh = Xs.shape[-1]
+                pj = jnp.where(j < fh, j, j - fh)
+                raw = jnp.take(Xs, pj, axis=-1).astype(jnp.int32)
+                return jnp.where(j < fh, raw & 15, raw >> 4)
+
             def split_column_full():
                 """Winning feature's bin values for ALL rows (this shard)."""
                 j = bundle.group_of[f] if has_bundle else f
-                col = jnp.take(X, j, axis=1).astype(jnp.int32)
+                col = fetch_col_of(X, j)
                 return bundle_remap(col) if has_bundle else col
 
             def go_left_of(col):
@@ -556,10 +577,10 @@ def make_grow_core(num_leaves: int, num_bins: int,
                             # rows-then-column touches cap*F bytes, column-
                             # then-rows touches n
                             if cap * X.shape[1] <= n:
-                                colb = jnp.take(jnp.take(X, blk, axis=0), j,
-                                                axis=1).astype(jnp.int32)
+                                colb = fetch_col_of(
+                                    jnp.take(X, blk, axis=0), j)
                             else:
-                                colb = jnp.take(jnp.take(X, j, axis=1),
+                                colb = jnp.take(fetch_col_of(X, j),
                                                 blk).astype(jnp.int32)
                             if has_bundle:
                                 colb = bundle_remap(colb)

@@ -133,17 +133,20 @@ def _onehot_accumulate(binned, w, num_bins: int, chunk: int,
 
 
 def gathered_histogram(X, grad, hess, row_mult, idx, valid, num_bins: int,
-                       mode: str, chunk: int = 16384):
+                       mode: str, chunk: int = 16384,
+                       logical_cols: int = 0):
     """(F, B, 3) histogram of the rows in `idx` (valid-masked).
 
     The gathered analog of leaf_histogram: X/grad/hess/row_mult are full-N;
     idx is a compacted (capacity,) row-index buffer from compact_rows.
+    logical_cols > 0: X is 4-bit packed (ops/pack.py); the gathered rows
+    stay packed and the accumulators unpack in-scan.
     """
-    Xs = jnp.take(X, idx, axis=0)                 # (C, F)
+    Xs = jnp.take(X, idx, axis=0)                 # (C, F) or (C, Fh) packed
     w = _gathered_weights(grad, hess, row_mult, idx, valid)
     if mode == "onehot":
-        return _onehot_accumulate(Xs, w, num_bins, chunk)
-    return _scatter_accumulate(Xs, w, num_bins)
+        return _onehot_accumulate(Xs, w, num_bins, chunk, logical_cols)
+    return _scatter_accumulate(Xs, w, num_bins, logical_cols)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "logical_cols"))

@@ -185,10 +185,11 @@ class SerialTreeLearner:
                            if growth == "wave" else 1)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
         # every device column fits a nibble, store TWO columns per byte in
-        # HBM; the wave engine unpacks per chunk in-scan, so the bin
-        # matrix's HBM footprint and read traffic halve.  Wave-only (the
-        # TPU default engine); exact/ordered growth and mesh learners keep
-        # byte bins.
+        # HBM; the growth engines unpack per chunk/column in-scan, so the
+        # bin matrix's HBM footprint and read traffic halve.  Supported by
+        # the wave engine (the TPU default) and by exact growth under the
+        # onehot/scatter kernels; the pallas kernels and mesh learners
+        # keep byte bins.
         from .pack import can_pack4
         bins_per_col = (train_data.bundle.num_group_bins
                         if train_data.bundle is not None
@@ -199,15 +200,25 @@ class SerialTreeLearner:
             Log.fatal("tpu_bin_pack: value %s cannot be parsed as "
                       "auto/bool", config.tpu_bin_pack)
         pack_forced = pack_cfg in _TRUE_SET
+        pack_growth_ok = (growth == "wave"
+                          or (growth == "exact"
+                              and hist_mode in ("onehot", "scatter")))
+        # mesh learners keep byte bins: data/voting arrive with psum_axis
+        # set, but the feature-parallel subclass calls this base ctor with
+        # psum_axis=None and a pre-sharded device matrix — gate on the
+        # tree_learner config, not just the axis
+        serial_learner = str(config.tree_learner) in ("serial",)
         self.packed_cols = 0
-        if ((pack_forced or pack_cfg == "auto") and growth == "wave"
-                and psum_axis is None and can_pack4(bins_per_col)):
+        if ((pack_forced or pack_cfg == "auto") and pack_growth_ok
+                and psum_axis is None and serial_learner
+                and can_pack4(bins_per_col)):
             self.packed_cols = ncols
         elif pack_forced:
             reasons = []
-            if growth != "wave":
-                reasons.append("tpu_growth=wave")
-            if psum_axis is not None:
+            if not pack_growth_ok:
+                reasons.append("wave growth or exact growth with the "
+                               "onehot/scatter histogram kernels")
+            if psum_axis is not None or not serial_learner:
                 reasons.append("the serial (single-shard) learner")
             if not can_pack4(bins_per_col):
                 reasons.append("at most 16 bins per column (max_bin<=15 "
@@ -283,7 +294,7 @@ class SerialTreeLearner:
                                  self.dtype, None, None, 0, 1,
                                  self.bundle_arrays is not None,
                                  self.group_bins, self.row_capacities,
-                                 self.cache_hists)
+                                 self.cache_hists, 15, self.packed_cols)
             meta, bund = self.meta, self.bundle_arrays
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta, _bund=bund):

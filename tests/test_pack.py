@@ -143,3 +143,75 @@ def test_pack_skipped_when_bins_too_wide():
     bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
                     num_boost_round=2)
     assert bst._gbdt.learner.packed_cols == 0
+
+
+@pytest.mark.parametrize("hist_mode", ["onehot", "scatter"])
+def test_exact_packed_equals_unpacked(hist_mode):
+    from lightgbm_tpu.ops.grow import make_grow_fn
+    cfg, td, meta, grad, hess, _ = _setup()
+    nb = int(td.num_bin_arr.max())
+    params = build_split_params(cfg)
+    ones = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(td.num_features, dtype=bool)
+
+    grow = make_grow_fn(L, nb, meta, params, cfg.max_depth,
+                        hist_mode=hist_mode)
+    t0, lid0 = grow(jnp.asarray(td.binned), grad, hess, ones, fmask)
+
+    packed = pack4_host(td.binned)
+    grow_p = make_grow_fn(L, nb, meta, params, cfg.max_depth,
+                          hist_mode=hist_mode,
+                          packed_cols=td.binned.shape[1])
+    t1, lid1 = grow_p(jnp.asarray(packed), grad, hess, ones, fmask)
+
+    _trees_equal(t0, t1)
+    np.testing.assert_array_equal(np.asarray(lid0), np.asarray(lid1))
+
+
+def test_exact_packed_ordered_mode():
+    # num_leaves-1 > 128 turns on the ordered-partition schedule: packed
+    # storage must survive the segment histogram AND the in-segment
+    # partition's nibble column fetch
+    from lightgbm_tpu.ops.grow import make_grow_fn
+    from lightgbm_tpu.ops.grow import default_row_capacities
+    cfg, td, meta, grad, hess, _ = _setup()
+    nb = int(td.num_bin_arr.max())
+    params = build_split_params(cfg)
+    ones = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(td.num_features, dtype=bool)
+    caps = default_row_capacities(N)
+    big_l = 131
+
+    grow = make_grow_fn(big_l, nb, meta, params, -1, hist_mode="onehot",
+                        row_capacities=caps)
+    t0, lid0 = grow(jnp.asarray(td.binned), grad, hess, ones, fmask)
+
+    packed = pack4_host(td.binned)
+    grow_p = make_grow_fn(big_l, nb, meta, params, -1, hist_mode="onehot",
+                          row_capacities=caps,
+                          packed_cols=td.binned.shape[1])
+    t1, lid1 = grow_p(jnp.asarray(packed), grad, hess, ones, fmask)
+
+    _trees_equal(t0, t1)
+    np.testing.assert_array_equal(np.asarray(lid0), np.asarray(lid1))
+
+
+def test_booster_exact_packed_end_to_end():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(N, 9))
+    y = ((X[:, 0] + X[:, 2] > 0.2)).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
+            "min_data_in_leaf": 3, "verbose": -1, "tpu_growth": "exact"}
+
+    def fit(pack):
+        params = dict(base, tpu_bin_pack=pack)
+        return lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                         num_boost_round=5)
+
+    b_on = fit("true")
+    b_off = fit("false")
+    np.testing.assert_allclose(b_on.predict(X), b_off.predict(X),
+                               rtol=1e-6)
+    assert b_on._gbdt.learner.packed_cols == 9
+    assert b_on._gbdt.learner.X.shape[1] == 5   # ceil(9/2): halved in HBM
+    assert b_off._gbdt.learner.packed_cols == 0

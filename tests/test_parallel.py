@@ -158,3 +158,29 @@ def test_end_to_end_feature_parallel_training(data):
                     train, num_boost_round=20, valid_sets=[train],
                     evals_result=evals, verbose_eval=False)
     assert evals["training"]["auc"][-1] > 0.97
+
+
+def test_feature_parallel_never_packs_nibbles():
+    """max_bin<=15 + tpu_bin_pack=auto must NOT pack under the
+    feature-parallel learner (its base ctor runs with psum_axis=None but
+    a pre-sharded device matrix; packing there would shard nibble bytes
+    as if they were bin columns). The tree must still match serial."""
+    from lightgbm_tpu.parallel.mesh import FeatureParallelTreeLearner
+    rng = np.random.default_rng(5)
+    n, f = 1200, 11
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - 0.5 * X[:, 3] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1,
+                  "max_bin": 15, "tree_learner": "feature",
+                  "enable_bundle": False})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(n, 0.25, dtype=np.float32)
+    fp = FeatureParallelTreeLearner(cfg, td)
+    assert fp.packed_cols == 0
+    cfg_s = Config({"num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1,
+                    "max_bin": 15, "enable_bundle": False})
+    td_s = TrainingData.from_matrix(X, label=y, config=cfg_s)
+    tree_s, _ = SerialTreeLearner(cfg_s, td_s).train(g, h)
+    tree_f, _ = fp.train(g, h)
+    assert _tree_signature(tree_f) == _tree_signature(tree_s)
