@@ -111,7 +111,16 @@ class GBDT:
         from ..ops.learner import SerialTreeLearner
         from ..parallel.mesh import create_tree_learner
         old = self.learner
-        if (type(old) is SerialTreeLearner
+        from ..ops.sparse_store import SparseDeviceStore
+        old_sparse = isinstance(getattr(old, "X", None), SparseDeviceStore)
+        if (type(old) is SerialTreeLearner and old_sparse
+                and bool(config.tpu_sparse)):
+            # reuse the device sparse store — train_data is unchanged on a
+            # hyperparameter reset, so the store is too
+            self.learner = SerialTreeLearner(
+                config, self.train_data, device_data=old.X,
+                device_sparse_col_cap=old.sparse_col_cap)
+        elif (type(old) is SerialTreeLearner and not old_sparse
                 and old.X.shape[0]
                 == self.train_data.num_data + old._row_pad):
             # reuse the uploaded (padded) bin matrix — no host->device
@@ -243,7 +252,9 @@ class GBDT:
         when bin thresholds exist, raw-data fallback for loaded models)."""
         if tree.num_leaves <= 1:
             return
-        if tree.has_bin_thresholds:
+        from ..ops.sparse_store import SparseDeviceStore
+        sparse_store = isinstance(self.learner.X, SparseDeviceStore)
+        if tree.has_bin_thresholds and not sparse_store:
             ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
             self._score_dev = self._score_dev.at[tid].set(
                 dev_predict.add_tree_to_score(
@@ -256,6 +267,10 @@ class GBDT:
             s[tid] += scale * tree.predict(self.train_data.raw_data)
             self._score_dev = self._score_dev.at[tid].set(
                 jnp.asarray(s[tid], self.score_dtype))
+        elif sparse_store:
+            Log.fatal("tpu_sparse=true keeps no dense device matrix to "
+                      "traverse; DART/rollback/continued training need the "
+                      "raw data (keep_raw) under the sparse store")
         else:
             Log.fatal("Cannot apply a loaded model to binned-only data; "
                       "keep raw data when continuing training")

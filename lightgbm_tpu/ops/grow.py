@@ -75,11 +75,20 @@ class TreeArrays(NamedTuple):
     leaf_depth: jnp.ndarray          # (L,) i32
 
 
-def feature_hist_view(ghist, sums, meta, bundle, has_bundle: bool):
+def feature_hist_view(ghist, sums, meta, bundle, has_bundle: bool,
+                      fix_default: bool = False):
     """Group histograms -> per-feature (F, B, 3) views with the default
     bin rebuilt by subtraction (FixHistogram, dataset.cpp:764-783).
-    Shared by the exact (grow) and wave growth engines."""
+    Shared by the exact (grow) and wave growth engines.
+
+    fix_default: reconstruct the default-bin slot even without a bundle —
+    the sparse store (ops/sparse_store.py) never materializes fill-bin
+    entries, so their slots arrive zero and carry the remainder."""
     if not has_bundle:
+        if fix_default:
+            fidx = jnp.arange(ghist.shape[0])
+            return ghist.at[fidx, meta.default_bin].set(
+                sums[None, :] - ghist.sum(axis=1))
         return ghist
     flat = ghist.reshape(-1, 3)
     v = flat[bundle.gather_idx] * bundle.valid_mask[..., None].astype(
@@ -122,7 +131,8 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                  voting_k: int = 0, num_voting_machines: int = 1,
                  bundle: BundleArrays = None, group_bins: int = 0,
                  row_capacities: tuple = (), cache_hists: bool = True,
-                 seg_after: int = 15, packed_cols: int = 0):
+                 seg_after: int = 15, packed_cols: int = 0,
+                 sparse_col_cap: int = 0):
     """Bind `meta`/`bundle` onto the shared memoized grow program.
 
     The heavy lifting lives in `make_grow_core`, which is cached on the
@@ -136,7 +146,7 @@ def make_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           voting_k, num_voting_machines,
                           bundle is not None, group_bins,
                           row_capacities, cache_hists, seg_after,
-                          packed_cols)
+                          packed_cols, sparse_col_cap)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -160,7 +170,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                    voting_k: int = 0, num_voting_machines: int = 1,
                    has_bundle: bool = False, group_bins: int = 0,
                    row_capacities: tuple = (), cache_hists: bool = True,
-                   seg_after: int = 15, packed_cols: int = 0):
+                   seg_after: int = 15, packed_cols: int = 0,
+                   sparse_col_cap: int = 0):
     """Build the jitted grow(X, grad, hess, row_mult, feature_mask) program.
 
     psum_axis: when set, histograms and scalar sums are psum'd over that
@@ -186,10 +197,18 @@ def make_grow_core(num_leaves: int, num_bins: int,
     if has_bundle and feature_axis is not None:
         raise ValueError("EFB bundling is not supported with the "
                          "feature-parallel learner (set enable_bundle=false)")
+    sparse_mode = hist_mode == "sparse"
+    if sparse_mode and (feature_axis is not None or voting_k > 0
+                        or packed_cols):
+        raise ValueError("tpu_sparse supports the serial/data-parallel "
+                         "exact engine only (no feature-parallel, voting, "
+                         "or 4-bit packing)")
     hist_bins = group_bins if has_bundle else num_bins
     # Pallas kernels take the full-N mask form; gathering only applies to
-    # the onehot/scatter kernels.
-    use_gather = len(row_capacities) > 0 and hist_mode != "pallas"
+    # the onehot/scatter kernels.  The sparse store has no row-gatherable
+    # dense matrix at all.
+    use_gather = (len(row_capacities) > 0
+                  and hist_mode not in ("pallas", "sparse"))
     # Ordered-partition mode: the carry holds a leaf-grouped row permutation
     # (DataPartition's indices_/leaf_begin_/leaf_count_, data_partition.hpp:
     # 94-147).  Each split touches ONLY the parent's segment — partition is
@@ -258,7 +277,13 @@ def make_grow_core(num_leaves: int, num_bins: int,
     if packed_cols and hist_mode == "pallas":
         raise ValueError("4-bit packing is not supported by the pallas "
                          "exact-growth kernel (use onehot/scatter)")
-    if hist_mode == "onehot":
+    if sparse_mode:
+        from .sparse_store import leaf_histogram_sparse
+
+        def hist_fn(X, g, h, leaf_id, leaf, row_mult):
+            return leaf_histogram_sparse(X, g, h, leaf_id, leaf, row_mult,
+                                         hist_bins, X.fill.shape[0])
+    elif hist_mode == "onehot":
         hist_fn = functools.partial(leaf_histogram_onehot,
                                     num_bins=hist_bins,
                                     logical_cols=packed_cols)
@@ -275,7 +300,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
                   "(expected auto/scatter/onehot/pallas)", hist_mode)
 
     def to_feature_hist(ghist, sums, meta, bundle):
-        return feature_hist_view(ghist, sums, meta, bundle, has_bundle)
+        return feature_hist_view(ghist, sums, meta, bundle, has_bundle,
+                                 fix_default=sparse_mode)
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -395,7 +421,7 @@ def make_grow_core(num_leaves: int, num_bins: int,
         return depth_gate(b, depth)
 
     def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
-        n = X.shape[0]
+        n = grad.shape[0]       # X may be a SparseDeviceStore pytree
         grad = grad.astype(hist_dtype)
         hess = hess.astype(hist_dtype)
         row_mult = row_mult.astype(hist_dtype)
@@ -522,7 +548,11 @@ def make_grow_core(num_leaves: int, num_bins: int,
             def split_column_full():
                 """Winning feature's bin values for ALL rows (this shard)."""
                 j = bundle.group_of[f] if has_bundle else f
-                col = fetch_col_of(X, j)
+                if sparse_mode:
+                    from .sparse_store import sparse_split_column
+                    col = sparse_split_column(X, j, n, sparse_col_cap)
+                else:
+                    col = fetch_col_of(X, j)
                 return bundle_remap(col) if has_bundle else col
 
             def go_left_of(col):

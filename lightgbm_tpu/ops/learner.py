@@ -116,8 +116,10 @@ def build_split_params(config: Config) -> SplitParams:
 class SerialTreeLearner:
     def __init__(self, config: Config, train_data: TrainingData,
                  psum_axis: Optional[str] = None, device_data=None,
-                 device_row_pad: int = 0, device_packed_cols: int = 0):
-        """device_data: pre-uploaded (and possibly row-padded) bin matrix;
+                 device_row_pad: int = 0, device_packed_cols: int = 0,
+                 device_sparse_col_cap: int = 0):
+        """device_data: pre-uploaded (and possibly row-padded) bin matrix,
+        or a SparseDeviceStore (with device_sparse_col_cap set);
         device_row_pad says how many trailing pad rows it carries so
         row_mult/_ones stay aligned (reset_config's no-reupload reuse);
         device_packed_cols: the logical column count when device_data is
@@ -127,11 +129,22 @@ class SerialTreeLearner:
         self.num_leaves = config.num_leaves
         self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
         self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
-        self.meta = FeatureMeta(
-            num_bin=jnp.asarray(train_data.num_bin_arr),
-            default_bin=jnp.asarray(train_data.default_bin_arr),
-            is_categorical=jnp.asarray(train_data.is_categorical_arr),
-        )
+        if train_data.num_features == 0:
+            # every feature was trivial ("no meaningful features" warned at
+            # load): feed ONE constant dummy column so the engines still
+            # produce the boost-from-average stump, as the reference does —
+            # the mesh learners already synthesize exactly this column
+            self.meta = FeatureMeta(
+                num_bin=jnp.asarray([2], jnp.int32),
+                default_bin=jnp.asarray([0], jnp.int32),
+                is_categorical=jnp.asarray([False]),
+            )
+        else:
+            self.meta = FeatureMeta(
+                num_bin=jnp.asarray(train_data.num_bin_arr),
+                default_bin=jnp.asarray(train_data.default_bin_arr),
+                is_categorical=jnp.asarray(train_data.is_categorical_arr),
+            )
         self.params = build_split_params(config)
         hist_mode = config.tpu_histogram_mode
         if hist_mode not in ("auto", "onehot", "scatter", "pallas",
@@ -177,6 +190,29 @@ class SerialTreeLearner:
         if growth == "exact" and hist_mode in ("pallas_t", "pallas_f"):
             Log.fatal("tpu_histogram_mode=%s requires tpu_growth=wave "
                       "(this kernel is wave-only)" % hist_mode)
+        # ---- sparse device store (SparseBin/OrderedSparseBin analog,
+        # ops/sparse_store.py): histograms from nonzero entries only, one
+        # segment_sum over nnz per leaf instead of an O(N*F) dense pass.
+        # Serial exact engine only; the wave engine keeps the dense store.
+        from ..utils.config import _FALSE_SET, _TRUE_SET
+        serial_learner = str(config.tree_learner) in ("serial",)
+        sparse_on = bool(config.tpu_sparse)
+        if sparse_on and (psum_axis is not None or not serial_learner):
+            Log.warning("tpu_sparse=true ignored: the sparse device store "
+                        "supports the serial learner only")
+            sparse_on = False
+        if sparse_on:
+            if hist_mode.startswith("pallas"):
+                Log.fatal("tpu_sparse=true is incompatible with "
+                          "tpu_histogram_mode=%s", hist_mode)
+            if growth == "wave" and config.tpu_growth == "wave":
+                Log.warning("tpu_sparse=true forces tpu_growth=exact "
+                            "(the wave engine keeps the dense store)")
+            growth = "exact"
+            hist_mode = "sparse"
+            self.hist_mode = hist_mode
+        self.sparse_on = sparse_on
+        self.sparse_col_cap = 0
         self.growth = growth
         # wave width only matters (and is only validated) under wave
         # growth — an exact-growth config with a leftover garbage
@@ -194,7 +230,6 @@ class SerialTreeLearner:
         bins_per_col = (train_data.bundle.num_group_bins
                         if train_data.bundle is not None
                         else train_data.num_bin_arr)
-        from ..utils.config import _FALSE_SET, _TRUE_SET
         pack_cfg = str(config.tpu_bin_pack).strip().lower()
         if pack_cfg not in _TRUE_SET | _FALSE_SET | {"auto"}:
             Log.fatal("tpu_bin_pack: value %s cannot be parsed as "
@@ -206,8 +241,7 @@ class SerialTreeLearner:
         # mesh learners keep byte bins: data/voting arrive with psum_axis
         # set, but the feature-parallel subclass calls this base ctor with
         # psum_axis=None and a pre-sharded device matrix — gate on the
-        # tree_learner config, not just the axis
-        serial_learner = str(config.tree_learner) in ("serial",)
+        # tree_learner config (serial_learner above), not just the axis
         self.packed_cols = 0
         if ((pack_forced or pack_cfg == "auto") and pack_growth_ok
                 and psum_axis is None and serial_learner
@@ -236,11 +270,41 @@ class SerialTreeLearner:
         # sizes land on the same compiled shape; pad rows carry zero
         # row_mult and change nothing)
         self._row_pad = device_row_pad
-        if device_data is not None and device_packed_cols == self.packed_cols:
+        if sparse_on:
+            from .sparse_store import (SparseDeviceStore,
+                                       build_sparse_store,
+                                       column_fill_bins)
+            self._row_pad = 0
+            if (isinstance(device_data, SparseDeviceStore)
+                    and device_sparse_col_cap > 0):
+                # reset_config reuse: same train_data -> same store
+                self.X = device_data
+                self.sparse_col_cap = device_sparse_col_cap
+                self.sparse_device_bytes = 4 * (
+                    3 * int(device_data.nz_row.shape[0])
+                    + 2 * int(device_data.fill.shape[0]) + 1)
+            else:
+                nbins_dev = (self.group_bins
+                             if train_data.bundle is not None
+                             else self.num_bins)
+                binned = train_data.binned
+                if binned.shape[1] == 0:    # dummy column (see meta above)
+                    binned = np.zeros((train_data.num_data, 1), np.uint8)
+                    fill = np.zeros(1, np.int64)
+                else:
+                    fill = column_fill_bins(train_data.num_bin_arr,
+                                            train_data.default_bin_arr,
+                                            train_data.bundle)
+                self.X, self.sparse_col_cap, self.sparse_device_bytes = \
+                    build_sparse_store(binned, fill, nbins_dev)
+        elif (device_data is not None
+                and device_packed_cols == self.packed_cols):
             self.X = device_data
         else:
             from .pack import pack4_host
             binned = train_data.binned
+            if binned.shape[1] == 0:        # dummy column (see meta above)
+                binned = np.zeros((train_data.num_data, 1), np.uint8)
             n = binned.shape[0]
             self._row_pad = (-n) % 1024
             if self._row_pad:
@@ -256,9 +320,10 @@ class SerialTreeLearner:
         # economics (data_partition.hpp:94-147, dense_bin.hpp:66-98) — so
         # the capacity-tier ladder pays at every shape.  Pallas histogram
         # kernels take the full-N mask form and keep the legacy path.
-        self.row_capacities = (default_row_capacities(int(self.X.shape[0]))
-                               if not hist_mode.startswith("pallas")
-                               else ())
+        self.row_capacities = (
+            default_row_capacities(train_data.num_data + self._row_pad)
+            if hist_mode not in ("pallas", "pallas_t", "pallas_f", "sparse")
+            else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice
         if growth == "wave" and psum_axis is None:
@@ -294,7 +359,8 @@ class SerialTreeLearner:
                                  self.dtype, None, None, 0, 1,
                                  self.bundle_arrays is not None,
                                  self.group_bins, self.row_capacities,
-                                 self.cache_hists, 15, self.packed_cols)
+                                 self.cache_hists, 15, self.packed_cols,
+                                 self.sparse_col_cap)
             meta, bund = self.meta, self.bundle_arrays
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta, _bund=bund):

@@ -132,6 +132,7 @@ PARAMETER_SET = {
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
+    "tpu_sparse",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -344,6 +345,11 @@ class Config:
         # two columns share a byte in HBM and the wave engine unpacks per
         # chunk.  auto = pack whenever eligible.
         "tpu_bin_pack": ("str", "auto"),
+        # device-side sparse bin storage (ops/sparse_store.py, SparseBin
+        # analog): per-leaf histograms become one segment_sum over the
+        # nonzero entries instead of an O(N*F) dense pass.  Serial exact
+        # engine only; default dense.
+        "tpu_sparse": ("bool", False),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -65,10 +65,13 @@ NOTES = {
     "tpu_wave_width": "W in wave growth; -1 = auto by num_leaves; 1 = the "
                       "reference's exact split order",
     "tpu_wave_chunk": "row-chunk of the wave sweep (VMEM vs scan-overhead "
-                      "tradeoff)",
-    "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t "
-                          "histogram kernels",
-    "tpu_bin_pack": "auto / true / false — 4-bit bin packing (max_bin<=15)",
+                      "tradeoff; minimum 256, smaller values clamp)",
+    "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t / "
+                          "pallas_f histogram kernels",
+    "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
+                    "bins/column: max_bin<=15 plus the reserved bin)",
+    "tpu_sparse": "true / false — device-side sparse bin store (serial "
+                  "exact engine; histograms from nonzeros only)",
     "tpu_use_dp": "float64 histograms/scores (gpu_use_dp analog)",
     "tpu_profile_dir": "write a jax.profiler trace per training run",
 }
@@ -108,8 +111,8 @@ GROUPS = [
         "machine_list_file", "histogram_pool_size"]),
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_chunk",
-        "tpu_histogram_mode", "tpu_bin_pack", "tpu_use_dp",
-        "tpu_profile_dir"]),
+        "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
+        "tpu_use_dp", "tpu_profile_dir"]),
 ]
 
 
