@@ -1,0 +1,91 @@
+# Booster surface — parity with R-package/R/lgb.Booster.R at the
+# reference (predict, save/load/dump, model string, eval results).
+
+#' Predict with a trained booster
+#'
+#' @param object lgb.Booster
+#' @param data matrix / data.frame / file path
+#' @param num_iteration number of iterations to use (-1 = all / best)
+#' @param rawscore return raw (pre-sigmoid) scores
+#' @param predleaf return per-tree leaf indices
+#' @export
+predict.lgb.Booster <- function(object, data, num_iteration = NULL,
+                                rawscore = FALSE, predleaf = FALSE,
+                                reshape = FALSE, ...) {
+  if (is.data.frame(data)) data <- data.matrix(data)
+  if (is.null(num_iteration)) {
+    num_iteration <- attr(object, "best_iter")
+    if (is.null(num_iteration) || num_iteration < 0L) num_iteration <- -1L
+  }
+  # reticulate already converts 2-D numpy results (pred_leaf, multiclass
+  # probabilities) to R matrices and 1-D results to numeric vectors —
+  # including for file-path data, where no local nrow exists
+  out <- object$predict(data, num_iteration = as.integer(num_iteration),
+                        raw_score = rawscore, pred_leaf = predleaf)
+  if (predleaf && is.matrix(out)) storage.mode(out) <- "integer"
+  out
+}
+
+#' @export
+print.lgb.Booster <- function(x, ...) {
+  cat(sprintf("<lgb.Booster: %d trees on %d features>\n",
+              x$num_trees(), x$num_feature()))
+  invisible(x)
+}
+
+#' Save the model text file (loadable by the reference too)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  if (!lgb.is.Booster(booster)) stop("lgb.save: need an lgb.Booster")
+  booster$save_model(filename, num_iteration = as.integer(num_iteration))
+  invisible(booster)
+}
+
+#' Load a model from a text file or string
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  lgb <- .lgb_py()
+  bst <- if (!is.null(filename)) lgb$Booster(model_file = filename)
+         else if (!is.null(model_str)) lgb$Booster(model_str = model_str)
+         else stop("lgb.load: give filename or model_str")
+  .lgb_tag_booster(bst)
+}
+
+#' Model as a nested list (parsed JSON dump)
+#' @export
+lgb.dump <- function(booster, num_iteration = -1L) {
+  if (!lgb.is.Booster(booster)) stop("lgb.dump: need an lgb.Booster")
+  booster$dump_model(num_iteration = as.integer(num_iteration))
+}
+
+#' Model in the reference-compatible text format
+#' @export
+lgb.model.to.string <- function(booster, num_iteration = -1L) {
+  if (!lgb.is.Booster(booster)) stop("lgb.model.to.string: need an lgb.Booster")
+  booster$model_to_string(num_iteration = as.integer(num_iteration))
+}
+
+#' Metric values recorded during training
+#'
+#' @param booster a booster returned by lgb.train (carries the record)
+#' @param data_name validation set name (e.g. "valid_0")
+#' @param eval_name metric name (e.g. "auc")
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- attr(booster, "record_evals")
+  if (!is.null(rec) && !is.null(rec[[data_name]])
+      && !is.null(rec[[data_name]][[eval_name]])) {
+    out <- as.numeric(rec[[data_name]][[eval_name]])
+    if (!is.null(iters)) out <- out[iters]
+    return(out)
+  }
+  # no training record (e.g. loaded model): fall back to a live eval pass
+  out <- c()
+  for (tup in booster$eval_valid()) {
+    if (identical(tup[[1]], data_name) && identical(tup[[2]], eval_name)) {
+      out <- c(out, tup[[3]])
+    }
+  }
+  out
+}

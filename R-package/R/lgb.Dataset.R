@@ -1,0 +1,140 @@
+# lgb.Dataset and its S3 surface — parity with the reference's
+# R-package/R/lgb.Dataset.R (construct, create.valid, save, categorical
+# and reference setters, dim/dimnames, getinfo/setinfo, slice).
+
+#' Create a lightgbm.tpu Dataset
+#'
+#' @param data matrix, data.frame, or file path
+#' @param label numeric vector of labels
+#' @param weight per-row weights
+#' @param group query sizes for ranking tasks
+#' @param init_score initial scores
+#' @param categorical_feature 1-based indices or column names
+#' @param reference training Dataset a validation set aligns with
+#' @param free_raw_data drop the raw matrix after binning
+#' @param params list of dataset parameters (max_bin, ...)
+#' @export
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, categorical_feature = NULL,
+                        reference = NULL, free_raw_data = TRUE,
+                        params = list(), ...) {
+  lgb <- .lgb_py()
+  if (is.data.frame(data)) data <- data.matrix(data)
+  # numpy arrays carry no dimnames: forward R column names explicitly so
+  # name-based categorical specs and dimnames() work
+  feat_names <- "auto"
+  if (is.matrix(data) && !is.null(colnames(data))) {
+    feat_names <- as.list(colnames(data))
+  }
+  ds <- lgb$Dataset(
+    data = data, label = label, weight = weight, group = group,
+    init_score = init_score, feature_name = feat_names,
+    categorical_feature = .as_py_categorical(categorical_feature),
+    reference = reference, free_raw_data = free_raw_data,
+    params = .as_py_params(c(params, list(...))))
+  .lgb_tag_dataset(ds)
+}
+
+#' Materialize (bin) a Dataset
+#' @export
+lgb.Dataset.construct <- function(dataset) {
+  if (!lgb.is.Dataset(dataset)) stop("lgb.Dataset.construct: need an lgb.Dataset")
+  dataset$construct()
+  invisible(dataset)
+}
+
+#' Validation Dataset aligned with a training Dataset
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  if (!lgb.is.Dataset(dataset)) stop("lgb.Dataset.create.valid: need an lgb.Dataset")
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+#' Save the binned Dataset to a binary file for fast reload
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  if (!lgb.is.Dataset(dataset)) stop("lgb.Dataset.save: need an lgb.Dataset")
+  dataset$construct()
+  dataset$save_binary(fname)
+  invisible(dataset)
+}
+
+#' Set the categorical feature spec (1-based indices or names)
+#' @export
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  if (!lgb.is.Dataset(dataset)) stop("lgb.Dataset.set.categorical: need an lgb.Dataset")
+  dataset$set_categorical_feature(.as_py_categorical(categorical_feature))
+  invisible(dataset)
+}
+
+#' Align a validation Dataset with its training Dataset
+#' @export
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  if (!lgb.is.Dataset(dataset)) stop("lgb.Dataset.set.reference: need an lgb.Dataset")
+  dataset$set_reference(reference)
+  invisible(dataset)
+}
+
+#' @export
+dim.lgb.Dataset <- function(x) {
+  x$construct()
+  c(x$num_data(), x$num_feature())
+}
+
+#' @export
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, unlist(x$get_feature_name()))
+}
+
+#' @export
+`dimnames<-.lgb.Dataset` <- function(x, value) {
+  if (!is.list(value) || length(value) != 2L) {
+    stop("dimnames<-.lgb.Dataset: value must be a list(NULL, colnames)")
+  }
+  if (!is.null(value[[2L]])) {
+    x$set_feature_name(as.list(as.character(value[[2L]])))
+  }
+  x
+}
+
+#' Generic information getter (label / weight / group / init_score)
+#' @export
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+
+#' @export
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  if (!name %in% c("label", "weight", "group", "init_score")) {
+    stop("getinfo: name must be label / weight / group / init_score")
+  }
+  out <- dataset$get_field(name)
+  if (is.null(out)) NULL else as.numeric(out)
+}
+
+#' Generic information setter
+#' @export
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+
+#' @export
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  if (!name %in% c("label", "weight", "group", "init_score")) {
+    stop("setinfo: name must be label / weight / group / init_score")
+  }
+  dataset$set_field(name, as.numeric(info))
+  invisible(dataset)
+}
+
+#' Row subset of a constructed Dataset (1-based indices)
+#' @export
+slice <- function(dataset, ...) UseMethod("slice")
+
+#' @export
+slice.lgb.Dataset <- function(dataset, idxset, ...) {
+  .lgb_tag_dataset(dataset$subset(as.list(as.integer(idxset - 1L))))
+}
+
+#' @export
+print.lgb.Dataset <- function(x, ...) {
+  d <- tryCatch(dim(x), error = function(e) c(NA_integer_, NA_integer_))
+  cat(sprintf("<lgb.Dataset: %s rows x %s features>\n", d[1L], d[2L]))
+  invisible(x)
+}

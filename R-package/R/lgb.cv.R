@@ -1,0 +1,47 @@
+# lgb.cv — parity with R-package/R/lgb.cv.R over engine.py cv()
+# (stratified/shuffled folds, per-iteration mean/stdv records).
+
+#' Cross validation
+#'
+#' @param params list of training parameters
+#' @param data lgb.Dataset
+#' @param nrounds boosting rounds
+#' @param nfold number of folds
+#' @param stratified stratify folds by label (classification)
+#' @param folds optional list of test-index vectors (1-based); overrides
+#'   nfold/stratified
+#' @export
+lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
+                   label = NULL, stratified = TRUE, folds = NULL,
+                   early_stopping_rounds = NULL, eval = NULL,
+                   verbose = 1L, seed = 0L, ...) {
+  if (!lgb.is.Dataset(data)) stop("lgb.cv: data must be an lgb.Dataset")
+  lgb <- .lgb_py()
+  if (!is.null(label)) setinfo(data, "label", label)
+  py_folds <- NULL
+  if (!is.null(folds)) {
+    n <- dim(data)[1L]
+    py_folds <- lapply(folds, function(test_idx) {
+      test0 <- as.integer(test_idx - 1L)
+      train0 <- setdiff(seq_len(n) - 1L, test0)
+      list(as.integer(train0), test0)
+    })
+  }
+  out <- lgb$cv(params = .as_py_params(c(params, list(...))),
+                train_set = data, num_boost_round = as.integer(nrounds),
+                nfold = as.integer(nfold), stratified = stratified,
+                folds = py_folds, metrics = eval,
+                early_stopping_rounds = .as_int_or_null(early_stopping_rounds),
+                verbose_eval = verbose > 0L, seed = as.integer(seed))
+  rec <- reticulate::py_to_r(out)
+  structure(list(record_evals = rec,
+                 best_iter = max(lengths(rec), 0L)),
+            class = "lgb.CVBooster")
+}
+
+#' @export
+print.lgb.CVBooster <- function(x, ...) {
+  cat(sprintf("<lgb.CVBooster: %d recorded metrics over %d iterations>\n",
+              length(x$record_evals), x$best_iter))
+  invisible(x)
+}

@@ -1,0 +1,27 @@
+# Feature importance — parity with R-package/R/lgb.importance.R.
+# Returns a data.frame (the reference returns data.table; base R keeps
+# this package dependency-free) with Feature / Gain / Frequency columns.
+
+#' Feature importance table
+#'
+#' @param model lgb.Booster
+#' @param percentage normalize columns to sum to 1
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  if (!lgb.is.Booster(model)) stop("lgb.importance: need an lgb.Booster")
+  gain <- as.numeric(model$feature_importance("gain"))
+  freq <- as.numeric(model$feature_importance("split"))
+  out <- data.frame(Feature = unlist(model$feature_name()),
+                    Gain = gain, Frequency = freq,
+                    stringsAsFactors = FALSE)
+  out <- out[out$Frequency > 0, , drop = FALSE]
+  out <- out[order(-out$Gain), , drop = FALSE]
+  if (percentage) {
+    if (sum(out$Gain) > 0) out$Gain <- out$Gain / sum(out$Gain)
+    if (sum(out$Frequency) > 0) {
+      out$Frequency <- out$Frequency / sum(out$Frequency)
+    }
+  }
+  rownames(out) <- NULL
+  out
+}

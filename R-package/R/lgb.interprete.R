@@ -1,0 +1,61 @@
+# Per-prediction feature contributions — parity with
+# R-package/R/lgb.interprete.R: for each observation, walk each tree's
+# root-to-leaf path and attribute the change in expected value at every
+# split to its feature.
+
+#' Feature contributions for individual predictions
+#'
+#' @param model lgb.Booster
+#' @param data feature matrix
+#' @param idxset 1-based row indices to interpret
+#' @return list (one per row) of data.frames Feature / Contribution,
+#'   sorted by absolute contribution
+#' @export
+lgb.interprete <- function(model, data, idxset, num_iteration = -1L) {
+  if (!lgb.is.Booster(model)) stop("lgb.interprete: need an lgb.Booster")
+  if (is.data.frame(data)) data <- data.matrix(data)
+  dump <- lgb.dump(model, num_iteration = num_iteration)
+  feat_names <- unlist(dump$feature_names)
+
+  interpret_row <- function(x) {
+    contrib <- stats::setNames(numeric(length(feat_names)), feat_names)
+    for (t in dump$tree_info) {
+      node <- t$tree_structure
+      prev <- as.numeric(node$internal_value)
+      while (is.null(node$leaf_value) || !is.null(node$split_feature)) {
+        f <- as.integer(node$split_feature) + 1L
+        thr <- as.numeric(node$threshold)
+        v <- x[f]
+        # mirror Tree.predict (models/tree.py:125-142): values in the
+        # missing range take the node's default_value redirect; the dump
+        # writes decision_type "is" (categorical ==) or "no_greater"
+        # (numerical <=); NaN comparisons go RIGHT like the C++ <=
+        if (!is.na(v) && v > -1e-20 && v <= 1e-20) {
+          v <- as.numeric(node$default_value)
+        }
+        go_left <- if (identical(node$decision_type, "is")) {
+          !is.na(v) && as.integer(v) == as.integer(thr)
+        } else {
+          !is.na(v) && v <= thr
+        }
+        node <- if (go_left) node$left_child else node$right_child
+        val <- if (!is.null(node$leaf_value) && is.null(node$split_feature)) {
+          as.numeric(node$leaf_value)
+        } else {
+          as.numeric(node$internal_value)
+        }
+        contrib[f] <- contrib[f] + (val - prev)
+        prev <- val
+      }
+    }
+    out <- data.frame(Feature = names(contrib),
+                      Contribution = as.numeric(contrib),
+                      stringsAsFactors = FALSE)
+    out <- out[out$Contribution != 0, , drop = FALSE]
+    out <- out[order(-abs(out$Contribution)), , drop = FALSE]
+    rownames(out) <- NULL
+    out
+  }
+
+  lapply(idxset, function(i) interpret_row(as.numeric(data[i, ])))
+}

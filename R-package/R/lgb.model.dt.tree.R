@@ -1,0 +1,63 @@
+# Flat per-node model table — parity with R-package/R/lgb.model.dt.tree.R
+# (tree_index / split_index / split_feature / threshold / children /
+# internal and leaf values), built from the JSON dump.  Returns a
+# data.frame instead of the reference's data.table.
+
+#' Per-node table of the model's trees
+#'
+#' @param model lgb.Booster
+#' @param num_iteration trees of the first n iterations (-1 = all)
+#' @export
+lgb.model.dt.tree <- function(model, num_iteration = -1L) {
+  if (!lgb.is.Booster(model)) stop("lgb.model.dt.tree: need an lgb.Booster")
+  dump <- lgb.dump(model, num_iteration = num_iteration)
+  feat_names <- unlist(dump$feature_names)
+  rows <- list()
+
+  walk <- function(node, tree_index, parent_index, depth) {
+    is_leaf <- !is.null(node$leaf_value) && is.null(node$split_feature)
+    idx <- length(rows) + 1L
+    if (is_leaf) {
+      rows[[idx]] <<- data.frame(
+        tree_index = tree_index, depth = depth,
+        split_index = NA_integer_,
+        split_feature = NA_character_,
+        node_parent = parent_index,
+        leaf_index = as.integer(node$leaf_index),
+        leaf_parent = parent_index,
+        split_gain = NA_real_, threshold = NA_real_,
+        decision_type = NA_character_,
+        internal_value = NA_real_,
+        internal_count = NA_integer_,
+        leaf_value = as.numeric(node$leaf_value),
+        leaf_count = as.integer(
+          if (is.null(node$leaf_count)) NA else node$leaf_count),
+        stringsAsFactors = FALSE)
+      return(invisible(NULL))
+    }
+    sidx <- as.integer(node$split_index)
+    f <- as.integer(node$split_feature)
+    rows[[idx]] <<- data.frame(
+      tree_index = tree_index, depth = depth,
+      split_index = sidx,
+      split_feature = if (f + 1L <= length(feat_names)) feat_names[f + 1L]
+                      else as.character(f),
+      node_parent = parent_index,
+      leaf_index = NA_integer_, leaf_parent = NA_integer_,
+      split_gain = as.numeric(node$split_gain),
+      threshold = as.numeric(node$threshold),
+      decision_type = as.character(node$decision_type),
+      internal_value = as.numeric(node$internal_value),
+      internal_count = as.integer(
+        if (is.null(node$internal_count)) NA else node$internal_count),
+      leaf_value = NA_real_, leaf_count = NA_integer_,
+      stringsAsFactors = FALSE)
+    walk(node$left_child, tree_index, sidx, depth + 1L)
+    walk(node$right_child, tree_index, sidx, depth + 1L)
+  }
+
+  for (t in dump$tree_info) {
+    walk(t$tree_structure, as.integer(t$tree_index), NA_integer_, 0L)
+  }
+  do.call(rbind, rows)
+}

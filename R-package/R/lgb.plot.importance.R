@@ -1,0 +1,24 @@
+# Importance bar plot — parity with R-package/R/lgb.plot.importance.R,
+# in base graphics (the reference uses graphics::barplot too).
+
+#' Plot feature importance as a horizontal bar chart
+#'
+#' @param tree_imp output of lgb.importance
+#' @param top_n show the n most important features
+#' @param measure "Gain" or "Frequency"
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L, measure = "Gain",
+                                left_margin = 10L, cex = NULL, ...) {
+  if (!measure %in% names(tree_imp)) {
+    stop("lgb.plot.importance: measure must be a column of lgb.importance")
+  }
+  tree_imp <- utils::head(tree_imp[order(-tree_imp[[measure]]), ,
+                                   drop = FALSE], top_n)
+  tree_imp <- tree_imp[rev(seq_len(nrow(tree_imp))), , drop = FALSE]
+  op <- graphics::par(mar = c(3, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(tree_imp[[measure]], names.arg = tree_imp$Feature,
+                    horiz = TRUE, las = 1, cex.names = cex,
+                    main = "Feature importance", xlab = measure, ...)
+  invisible(tree_imp)
+}

@@ -1,0 +1,23 @@
+# Contribution bar plot — parity with
+# R-package/R/lgb.plot.interpretation.R, in base graphics.
+
+#' Plot one observation's feature contributions
+#'
+#' @param tree_interpretation one element of lgb.interprete's output
+#' @param top_n show the n largest absolute contributions
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    cols = 1L, left_margin = 10L,
+                                    cex = NULL, ...) {
+  ti <- utils::head(tree_interpretation, top_n)
+  ti <- ti[rev(seq_len(nrow(ti))), , drop = FALSE]
+  op <- graphics::par(mar = c(3, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(ti$Contribution, names.arg = ti$Feature, horiz = TRUE,
+                    las = 1, cex.names = cex,
+                    col = ifelse(ti$Contribution > 0, "forestgreen",
+                                 "firebrick"),
+                    main = "Feature contribution", xlab = "Contribution",
+                    ...)
+  invisible(ti)
+}

@@ -1,0 +1,56 @@
+# Data preparation helpers — parity with R-package/R/lgb.prepare.R,
+# lgb.prepare2.R, lgb.prepare_rules.R, lgb.prepare_rules2.R: convert
+# factor/character columns to numeric codes, optionally recording the
+# level maps so validation/test frames code identically.
+
+#' Convert factor/character columns to numeric (no rules recorded)
+#' @export
+lgb.prepare <- function(data) {
+  lgb.prepare_rules(data)$data
+}
+
+#' Convert factor/character columns to integer (no rules recorded)
+#' @export
+lgb.prepare2 <- function(data) {
+  lgb.prepare_rules2(data)$data
+}
+
+#' Convert to numeric and record per-column level maps
+#'
+#' @param data data.frame
+#' @param rules previously recorded rules to re-apply (valid/test data)
+#' @return list(data = converted frame, rules = named list of level maps)
+#' @export
+lgb.prepare_rules <- function(data, rules = NULL) {
+  out <- .lgb_prepare_impl(data, rules, as_fun = as.numeric)
+  out
+}
+
+#' Integer-coded variant of lgb.prepare_rules
+#' @export
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  .lgb_prepare_impl(data, rules, as_fun = as.integer)
+}
+
+.lgb_prepare_impl <- function(data, rules, as_fun) {
+  if (!is.data.frame(data)) {
+    return(list(data = data, rules = if (is.null(rules)) list() else rules))
+  }
+  new_rules <- if (is.null(rules)) list() else rules
+  for (col in names(data)) {
+    v <- data[[col]]
+    if (is.character(v)) v <- factor(v)
+    if (is.factor(v)) {
+      if (!is.null(new_rules[[col]])) {
+        lv <- new_rules[[col]]
+        v <- factor(as.character(v), levels = names(lv))
+        data[[col]] <- as_fun(unname(lv[as.character(v)]))
+      } else {
+        lv <- stats::setNames(seq_along(levels(v)), levels(v))
+        new_rules[[col]] <- lv
+        data[[col]] <- as_fun(lv[as.character(v)])
+      }
+    }
+  }
+  list(data = data, rules = new_rules)
+}

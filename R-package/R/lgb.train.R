@@ -1,0 +1,42 @@
+# lgb.train — parity with R-package/R/lgb.train.R (valids, eval record,
+# early stopping, continued training) over the Python engine
+# (engine.py:17-203 semantics).
+
+#' Train a boosting model
+#'
+#' @param params list of training parameters (reference names/aliases)
+#' @param data training lgb.Dataset
+#' @param nrounds boosting rounds
+#' @param valids named list of validation lgb.Datasets
+#' @param early_stopping_rounds stop when no metric improves this long
+#' @param init_model path or lgb.Booster to continue from
+#' @param verbose verbosity (<=0 silences per-iteration lines)
+#' @param categorical_feature forwarded to the Dataset when given
+#' @param colnames feature names override
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 10L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      init_model = NULL, verbose = 1L, eval_freq = 1L,
+                      categorical_feature = NULL, colnames = NULL, ...) {
+  if (!lgb.is.Dataset(data)) stop("lgb.train: data must be an lgb.Dataset")
+  lgb <- .lgb_py()
+  if (!is.null(categorical_feature)) {
+    lgb.Dataset.set.categorical(data, categorical_feature)
+  }
+  if (!is.null(colnames)) {
+    data$set_feature_name(as.list(as.character(colnames)))
+  }
+  evals <- reticulate::dict()
+  bst <- lgb$train(
+    params = .as_py_params(c(params, list(...))), train_set = data,
+    num_boost_round = as.integer(nrounds),
+    valid_sets = unname(valids), valid_names = names(valids),
+    early_stopping_rounds = .as_int_or_null(early_stopping_rounds),
+    init_model = init_model,
+    evals_result = evals,
+    verbose_eval = if (verbose > 0L) as.integer(eval_freq) else FALSE)
+  bst <- .lgb_tag_booster(bst)
+  attr(bst, "record_evals") <- reticulate::py_to_r(evals)
+  attr(bst, "best_iter") <- as.integer(bst$best_iteration)
+  bst
+}

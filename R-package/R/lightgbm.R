@@ -1,0 +1,45 @@
+# Convenience trainer + unloader — parity with R-package/R/lightgbm.R
+# and lgb.unloader.R at the reference.
+
+#' Train directly from a matrix + label (wraps lgb.train)
+#'
+#' @param data matrix / data.frame / lgb.Dataset
+#' @param label labels (ignored when data is already an lgb.Dataset)
+#' @param save_name model file written after training ("" skips saving)
+#' @export
+lightgbm <- function(data, label = NULL, weight = NULL, params = list(),
+                     nrounds = 10L, verbose = 1L, eval_freq = 1L,
+                     early_stopping_rounds = NULL,
+                     save_name = "lightgbm.model", init_model = NULL,
+                     ...) {
+  dtrain <- data
+  if (!lgb.is.Dataset(dtrain)) {
+    dtrain <- lgb.Dataset(data, label = label, weight = weight)
+  }
+  valids <- list()
+  if (verbose > 0L) valids$train <- dtrain
+  bst <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                   valids = valids, verbose = verbose,
+                   eval_freq = eval_freq,
+                   early_stopping_rounds = early_stopping_rounds,
+                   init_model = init_model, ...)
+  if (is.character(save_name) && nzchar(save_name)) {
+    lgb.save(bst, save_name)
+  }
+  bst
+}
+
+#' Drop the cached Python runtime handle (the reference's lgb.unloader
+#' unloads lib_lightgbm; here the runtime is the reticulate module)
+#' @export
+lgb.unloader <- function(restore = TRUE, wipe = FALSE, envir = .GlobalEnv) {
+  .lgb_env$mod <- NULL
+  if (wipe) {
+    drop <- Filter(function(nm) {
+      obj <- get(nm, envir = envir)
+      lgb.is.Dataset(obj) || lgb.is.Booster(obj)
+    }, ls(envir = envir))
+    rm(list = drop, envir = envir)
+  }
+  invisible(NULL)
+}

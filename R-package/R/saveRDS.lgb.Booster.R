@@ -1,0 +1,37 @@
+# RDS persistence — parity with R-package/R/saveRDS.lgb.Booster.R and
+# readRDS.lgb.Booster.R: the booster handle is a live runtime object, so
+# the RDS carries the reference-compatible model TEXT (the durable
+# serialization surface, gbdt.cpp:817-861) plus the training attributes.
+
+#' Save an lgb.Booster to an RDS file
+#'
+#' @param object lgb.Booster
+#' @param file path to write
+#' @export
+saveRDS.lgb.Booster <- function(object, file = "", ascii = FALSE,
+                                version = NULL, compress = TRUE,
+                                refhook = NULL) {
+  if (!lgb.is.Booster(object)) stop("saveRDS.lgb.Booster: need an lgb.Booster")
+  payload <- list(model_str = lgb.model.to.string(object),
+                  best_iter = attr(object, "best_iter"),
+                  record_evals = attr(object, "record_evals"))
+  class(payload) <- "lgb.Booster.rds"
+  saveRDS(payload, file = file, ascii = ascii, version = version,
+          compress = compress, refhook = refhook)
+  invisible(object)
+}
+
+#' Restore an lgb.Booster from an RDS file
+#'
+#' @param file path written by saveRDS.lgb.Booster
+#' @export
+readRDS.lgb.Booster <- function(file = "", refhook = NULL) {
+  payload <- readRDS(file = file, refhook = refhook)
+  if (!inherits(payload, "lgb.Booster.rds")) {
+    stop("readRDS.lgb.Booster: file was not written by saveRDS.lgb.Booster")
+  }
+  bst <- lgb.load(model_str = payload$model_str)
+  attr(bst, "best_iter") <- payload$best_iter
+  attr(bst, "record_evals") <- payload$record_evals
+  bst
+}

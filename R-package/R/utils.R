@@ -1,0 +1,56 @@
+# Internal plumbing for the lightgbm.tpu R package.
+#
+# The reference R package reaches C++ through 633 lines of SEXP glue
+# (src/lightgbm_R.cpp) over the C API.  Here the compute plane is XLA
+# driven from Python, so the FFI boundary is the Python package via
+# reticulate; every exported function delegates to the same lightgbm_tpu
+# calls the Python API uses, keeping one behavior for both languages.
+
+.lgb_env <- new.env(parent = emptyenv())
+
+.lgb_py <- function() {
+  if (is.null(.lgb_env$mod)) {
+    if (!requireNamespace("reticulate", quietly = TRUE)) {
+      stop("lightgbm.tpu requires the 'reticulate' package")
+    }
+    .lgb_env$mod <- reticulate::import("lightgbm_tpu")
+  }
+  .lgb_env$mod
+}
+
+.as_py_params <- function(params) {
+  if (is.null(params)) params <- list()
+  # R scalars pass through reticulate; names kept verbatim — parameter
+  # names/aliases are the cross-language API (config.h:360-489)
+  params
+}
+
+# categorical_feature: R is 1-based; as.list keeps length-1 vectors a
+# Python list (not a bare scalar) through reticulate
+.as_py_categorical <- function(categorical_feature) {
+  if (is.null(categorical_feature)) {
+    "auto"
+  } else if (is.numeric(categorical_feature)) {
+    as.list(as.integer(categorical_feature - 1L))
+  } else {
+    as.list(categorical_feature)   # column names, resolved Python-side
+  }
+}
+
+.as_int_or_null <- function(x) {
+  if (is.null(x)) NULL else as.integer(x)
+}
+
+lgb.is.Dataset <- function(x) inherits(x, "lgb.Dataset")
+
+lgb.is.Booster <- function(x) inherits(x, "lgb.Booster")
+
+.lgb_tag_dataset <- function(ds) {
+  class(ds) <- unique(c("lgb.Dataset", class(ds)))
+  ds
+}
+
+.lgb_tag_booster <- function(bst) {
+  class(bst) <- unique(c("lgb.Booster", class(bst)))
+  bst
+}

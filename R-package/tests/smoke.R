@@ -1,33 +1,94 @@
-# Smoke test for the lightgbm_tpu R bridge (run: Rscript tests/smoke.R).
-# Mirrors the reference R-package test style (R-package/tests/) at the
-# smallest useful scale: Dataset -> train -> predict -> save/load round-trip.
-source(file.path(dirname(sub("--file=", "", grep("--file=", commandArgs(FALSE),
-                                                 value = TRUE))), "..", "R",
-                 "lightgbm_tpu.R"))
+# End-to-end test of the lightgbm.tpu R package (run: Rscript tests/smoke.R).
+# Mirrors the reference R-package test style: Dataset -> train with valids
+# -> predict -> save/load -> RDS round-trip -> importance / tree table /
+# interpretation -> cv -> Dataset accessors.
+
+this_file <- sub("--file=", "", grep("--file=", commandArgs(FALSE),
+                                     value = TRUE))
+r_dir <- file.path(dirname(this_file), "..", "R")
+for (f in c("utils.R", "lgb.Dataset.R", "lgb.Booster.R", "lgb.train.R",
+            "lgb.cv.R", "lightgbm.R", "lgb.importance.R",
+            "lgb.model.dt.tree.R", "lgb.interprete.R",
+            "lgb.plot.importance.R", "lgb.plot.interpretation.R",
+            "lgb.prepare.R", "saveRDS.lgb.Booster.R")) {
+  source(file.path(r_dir, f))
+}
 
 set.seed(42)
-n <- 400
+n <- 500
 x <- matrix(rnorm(n * 4), ncol = 4)
+colnames(x) <- paste0("f", 1:4)
 y <- as.numeric(x[, 1] + 0.5 * x[, 2] > 0)
+xv <- matrix(rnorm(200 * 4), ncol = 4)
+yv <- as.numeric(xv[, 1] + 0.5 * xv[, 2] > 0)
 
+# ---- Dataset accessors
 dtrain <- lgb.Dataset(x, label = y)
+stopifnot(identical(dim(dtrain), c(500L, 4L)))
+stopifnot(identical(dimnames(dtrain)[[2]], paste0("f", 1:4)))
+setinfo(dtrain, "weight", rep(1.0, n))
+stopifnot(length(getinfo(dtrain, "label")) == n)
+dsub <- slice(dtrain, 1:100)
+stopifnot(dim(dsub)[1] == 100L)
+
+# ---- training with a valid set + eval record
+dvalid <- lgb.Dataset.create.valid(dtrain, xv, label = yv)
 bst <- lgb.train(params = list(objective = "binary", num_leaves = 7,
-                               learning_rate = 0.2, verbose = -1),
-                 data = dtrain, nrounds = 20L)
+                               learning_rate = 0.2, metric = "binary_logloss",
+                               verbose = -1),
+                 data = dtrain, nrounds = 25L,
+                 valids = list(valid_0 = dvalid), verbose = 0L)
+ev <- lgb.get.eval.result(bst, "valid_0", "binary_logloss")
+stopifnot(length(ev) == 25L, ev[25] < ev[1])
 
-pred <- predict.lgb.Booster(bst, x)
-stopifnot(length(pred) == n)
-acc <- mean((pred > 0.5) == (y > 0.5))
-cat(sprintf("train accuracy: %.3f\n", acc))
-stopifnot(acc > 0.9)
+pred <- predict(bst, x)
+stopifnot(length(pred) == n, mean((pred > 0.5) == (y > 0.5)) > 0.9)
 
+# ---- save / load (text model)
 f <- tempfile(fileext = ".txt")
 lgb.save(bst, f)
 bst2 <- lgb.load(filename = f)
-pred2 <- predict.lgb.Booster(bst2, x)
-stopifnot(max(abs(pred - pred2)) < 1e-9)
+stopifnot(max(abs(pred - predict(bst2, x))) < 1e-9)
+stopifnot(nchar(lgb.model.to.string(bst)) > 100)
 
+# ---- RDS round-trip
+rds <- tempfile(fileext = ".rds")
+saveRDS.lgb.Booster(bst, rds)
+bst3 <- readRDS.lgb.Booster(rds)
+stopifnot(max(abs(pred - predict(bst3, x))) < 1e-9)
+stopifnot(length(lgb.get.eval.result(bst3, "valid_0", "binary_logloss")) == 25L)
+
+# ---- importance / tree table / interpretation
 imp <- lgb.importance(bst)
-stopifnot(length(imp) == 4)
+stopifnot(is.data.frame(imp), nrow(imp) >= 2, imp$Feature[1] %in% c("f1", "f2"))
+dt <- lgb.model.dt.tree(bst)
+stopifnot(is.data.frame(dt), sum(!is.na(dt$leaf_value)) > 0,
+          max(dt$tree_index) == 24)
+ii <- lgb.interprete(bst, x, idxset = 1:2)
+stopifnot(length(ii) == 2, is.data.frame(ii[[1]]))
+pdf(NULL)  # plots render headlessly
+lgb.plot.importance(imp, top_n = 3)
+lgb.plot.interpretation(ii[[1]])
+dev.off()
+
+# ---- cv
+cv <- lgb.cv(params = list(objective = "binary", num_leaves = 7,
+                           metric = "binary_logloss", verbose = -1),
+             data = lgb.Dataset(x, label = y), nrounds = 8L, nfold = 3L,
+             stratified = FALSE, verbose = 0L)
+stopifnot(inherits(cv, "lgb.CVBooster"),
+          length(cv$record_evals[["binary_logloss-mean"]]) == 8L)
+
+# ---- lightgbm() convenience + prepare
+df <- data.frame(a = rnorm(50), b = factor(sample(c("x", "y", "z"), 50,
+                                                  replace = TRUE)))
+pr <- lgb.prepare_rules(df)
+stopifnot(is.numeric(pr$data$b), length(pr$rules$b) == 3)
+pr2 <- lgb.prepare_rules(df[1:10, ], rules = pr$rules)
+stopifnot(identical(pr2$data$b[1:10], pr$data$b[1:10]))
+bst4 <- lightgbm(x, label = y,
+                 params = list(objective = "binary", verbose = -1),
+                 nrounds = 5L, verbose = 0L, save_name = "")
+stopifnot(length(predict(bst4, x)) == n)
 
 cat("R smoke test OK\n")

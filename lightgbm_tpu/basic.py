@@ -397,6 +397,11 @@ class Dataset:
             return self.data.shape[0]
         Log.fatal("Cannot get num_data before construct")
 
+    def get_feature_name(self) -> List[str]:
+        """Feature names after construction (auto names resolved)."""
+        self.construct()
+        return list(self._handle.feature_names)
+
     def num_feature(self) -> int:
         """Feature count; requires raw ndarray data or a constructed
         dataset (matches the reference's construct-first contract)."""
