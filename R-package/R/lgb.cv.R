@@ -24,7 +24,9 @@ lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
     py_folds <- lapply(folds, function(test_idx) {
       test0 <- as.integer(test_idx - 1L)
       train0 <- setdiff(seq_len(n) - 1L, test0)
-      list(as.integer(train0), test0)
+      # as.list keeps length-1 index vectors Python lists (not bare
+      # scalars) through reticulate, same as .as_py_categorical
+      list(as.list(as.integer(train0)), as.list(test0))
     })
   }
   out <- lgb$cv(params = .as_py_params(c(params, list(...))),

@@ -8,18 +8,24 @@
 #' @param model lgb.Booster
 #' @param data feature matrix
 #' @param idxset 1-based row indices to interpret
-#' @return list (one per row) of data.frames Feature / Contribution,
-#'   sorted by absolute contribution
+#' @return list (one per row) of data.frames: Feature plus one
+#'   contribution column per class ("Contribution" for single-class
+#'   models, "Class_0".."Class_k" for multiclass — the reference's
+#'   layout), sorted by the first class's absolute contribution
 #' @export
 lgb.interprete <- function(model, data, idxset, num_iteration = -1L) {
   if (!lgb.is.Booster(model)) stop("lgb.interprete: need an lgb.Booster")
   if (is.data.frame(data)) data <- data.matrix(data)
   dump <- lgb.dump(model, num_iteration = num_iteration)
   feat_names <- unlist(dump$feature_names)
+  num_tpi <- max(as.integer(dump$num_tree_per_iteration), 1L)
 
   interpret_row <- function(x) {
-    contrib <- stats::setNames(numeric(length(feat_names)), feat_names)
-    for (t in dump$tree_info) {
+    contrib <- matrix(0.0, nrow = length(feat_names), ncol = num_tpi,
+                      dimnames = list(feat_names, NULL))
+    for (ti in seq_along(dump$tree_info)) {
+      t <- dump$tree_info[[ti]]
+      cls <- (as.integer(t$tree_index) %% num_tpi) + 1L
       node <- t$tree_structure
       prev <- as.numeric(node$internal_value)
       while (is.null(node$leaf_value) || !is.null(node$split_feature)) {
@@ -44,15 +50,21 @@ lgb.interprete <- function(model, data, idxset, num_iteration = -1L) {
         } else {
           as.numeric(node$internal_value)
         }
-        contrib[f] <- contrib[f] + (val - prev)
+        contrib[f, cls] <- contrib[f, cls] + (val - prev)
         prev <- val
       }
     }
-    out <- data.frame(Feature = names(contrib),
-                      Contribution = as.numeric(contrib),
-                      stringsAsFactors = FALSE)
-    out <- out[out$Contribution != 0, , drop = FALSE]
-    out <- out[order(-abs(out$Contribution)), , drop = FALSE]
+    out <- data.frame(Feature = feat_names, stringsAsFactors = FALSE)
+    if (num_tpi == 1L) {
+      out$Contribution <- contrib[, 1L]
+    } else {
+      for (k in seq_len(num_tpi)) {
+        out[[sprintf("Class_%d", k - 1L)]] <- contrib[, k]
+      }
+    }
+    keep <- rowSums(abs(contrib)) != 0
+    out <- out[keep, , drop = FALSE]
+    out <- out[order(-abs(out[[2L]])), , drop = FALSE]
     rownames(out) <- NULL
     out
   }

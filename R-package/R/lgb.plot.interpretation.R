@@ -5,19 +5,31 @@
 #'
 #' @param tree_interpretation one element of lgb.interprete's output
 #' @param top_n show the n largest absolute contributions
+#' @param cols panel columns when the model is multiclass (one panel per
+#'   class, the reference's layout)
 #' @export
 lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
                                     cols = 1L, left_margin = 10L,
                                     cex = NULL, ...) {
-  ti <- utils::head(tree_interpretation, top_n)
-  ti <- ti[rev(seq_len(nrow(ti))), , drop = FALSE]
+  value_cols <- setdiff(names(tree_interpretation), "Feature")
   op <- graphics::par(mar = c(3, left_margin, 2, 1))
   on.exit(graphics::par(op))
-  graphics::barplot(ti$Contribution, names.arg = ti$Feature, horiz = TRUE,
-                    las = 1, cex.names = cex,
-                    col = ifelse(ti$Contribution > 0, "forestgreen",
-                                 "firebrick"),
-                    main = "Feature contribution", xlab = "Contribution",
-                    ...)
-  invisible(ti)
+  if (length(value_cols) > 1L) {
+    rows <- ceiling(length(value_cols) / cols)
+    graphics::par(mfrow = c(rows, cols))
+  }
+  for (vc in value_cols) {
+    ti <- tree_interpretation[
+      order(-abs(tree_interpretation[[vc]])), , drop = FALSE]
+    ti <- utils::head(ti, top_n)
+    ti <- ti[rev(seq_len(nrow(ti))), , drop = FALSE]
+    graphics::barplot(ti[[vc]], names.arg = ti$Feature, horiz = TRUE,
+                      las = 1, cex.names = cex,
+                      col = ifelse(ti[[vc]] > 0, "forestgreen",
+                                   "firebrick"),
+                      main = if (length(value_cols) > 1L) vc
+                             else "Feature contribution",
+                      xlab = "Contribution", ...)
+  }
+  invisible(tree_interpretation)
 }

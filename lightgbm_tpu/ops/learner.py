@@ -83,18 +83,27 @@ def build_bundle_arrays(train_data: TrainingData):
 
 
 def resolve_wave_width(config: Config, num_leaves: int) -> int:
-    """tpu_wave_width=-1 -> auto: scale the wave to the frontier size.
+    """tpu_wave_width=-1 -> auto: scale the wave to the frontier size,
+    gated on QUALITY, not only speed.
 
-    Measured on v5e (1M x 28, BENCH_NOTES.md): W=16 is fastest at 63
-    leaves, W=32 at 255 — bigger waves amortize the per-sweep pass over
-    more splits, but at small trees they just pad the frontier.  Explicit
-    values (including 1 = the reference's exact split order) pass through.
+    Speed (v5e, 1M x 28, BENCH_NOTES.md): W=16 is fastest at 63 leaves,
+    W=32 at 255 — bigger waves amortize the per-sweep pass over more
+    splits, but at small trees they just pad the frontier.
+
+    Quality (PARITY_TRAINING.md): batched frontiers approximate the
+    leaf-wise split ORDER; at W=8 the measured deltas vs the reference
+    are within ~1e-3 for binary/multiclass AUC-style metrics but up to
+    -6.4e-3 NDCG@10 on lambdarank — ranking gains are order-sensitive,
+    so auto resolves to W=1 (the reference's exact split sequence) for
+    ranking objectives.  Explicit user values always pass through.
     """
     w = int(config.tpu_wave_width)
     if w > 0:
         return w
     if w != -1:
         Log.fatal("tpu_wave_width must be positive or -1 (auto), got %d", w)
+    if str(config.objective) in ("lambdarank", "rank"):
+        return 1
     if num_leaves <= 31:
         return 8
     if num_leaves <= 127:

@@ -212,3 +212,19 @@ def test_wave_with_row_weighted_boosters(boosting, extra):
     pick = lambda s: [l for l in s.splitlines()
                       if l.startswith(structural)]
     assert pick(out["wave"]) == pick(out["exact"])
+
+
+def test_wave_width_auto_ranking_quality_gate():
+    """Auto wave width resolves to 1 (the reference's exact split order)
+    for ranking objectives — PARITY_TRAINING.md measured -6.4e-3 NDCG@10
+    at W=8, so the auto policy is gated on quality, not only speed."""
+    from lightgbm_tpu.ops.learner import resolve_wave_width
+    cfg = Config({"verbose": -1, "objective": "lambdarank"})
+    assert resolve_wave_width(cfg, 255) == 1
+    # explicit values still win
+    cfg2 = Config({"verbose": -1, "objective": "lambdarank",
+                   "tpu_wave_width": 16})
+    assert resolve_wave_width(cfg2, 255) == 16
+    # non-ranking keeps the speed ladder
+    assert resolve_wave_width(Config({"verbose": -1,
+                                      "objective": "binary"}), 255) == 32
