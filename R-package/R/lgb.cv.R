@@ -21,12 +21,13 @@ lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
   py_folds <- NULL
   if (!is.null(folds)) {
     n <- dim(data)[1L]
+    # length-1 index vectors cross reticulate as bare scalars; box ONLY
+    # those (boxing a large vector element-wise is orders slower)
+    box1 <- function(v) if (length(v) == 1L) as.list(v) else v
     py_folds <- lapply(folds, function(test_idx) {
       test0 <- as.integer(test_idx - 1L)
-      train0 <- setdiff(seq_len(n) - 1L, test0)
-      # as.list keeps length-1 index vectors Python lists (not bare
-      # scalars) through reticulate, same as .as_py_categorical
-      list(as.list(as.integer(train0)), as.list(test0))
+      train0 <- as.integer(setdiff(seq_len(n) - 1L, test0))
+      list(box1(train0), box1(test0))
     })
   }
   out <- lgb$cv(params = .as_py_params(c(params, list(...))),

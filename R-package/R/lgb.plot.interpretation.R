@@ -12,12 +12,12 @@ lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
                                     cols = 1L, left_margin = 10L,
                                     cex = NULL, ...) {
   value_cols <- setdiff(names(tree_interpretation), "Feature")
-  op <- graphics::par(mar = c(3, left_margin, 2, 1))
-  on.exit(graphics::par(op))
+  par_args <- list(mar = c(3, left_margin, 2, 1))
   if (length(value_cols) > 1L) {
-    rows <- ceiling(length(value_cols) / cols)
-    graphics::par(mfrow = c(rows, cols))
+    par_args$mfrow <- c(ceiling(length(value_cols) / cols), cols)
   }
+  op <- do.call(graphics::par, par_args)   # captures old mar AND mfrow
+  on.exit(graphics::par(op))
   for (vc in value_cols) {
     ti <- tree_interpretation[
       order(-abs(tree_interpretation[[vc]])), , drop = FALSE]

@@ -92,10 +92,13 @@ def resolve_wave_width(config: Config, num_leaves: int) -> int:
 
     Quality (PARITY_TRAINING.md): batched frontiers approximate the
     leaf-wise split ORDER; at W=8 the measured deltas vs the reference
-    are within ~1e-3 for binary/multiclass AUC-style metrics but up to
-    -6.4e-3 NDCG@10 on lambdarank — ranking gains are order-sensitive,
-    so auto resolves to W=1 (the reference's exact split sequence) for
-    ranking objectives.  Explicit user values always pass through.
+    are within ~1e-3 for plain-GBDT binary/multiclass metrics but
+    -6.4e-3 NDCG@10 on lambdarank (ranking gains are order-sensitive)
+    and +0.9e-2..+3e-2 logloss under DART/GOSS/InfiniteBoost (their
+    tree re-weighting / gradient sampling compounds the order
+    approximation) — so auto resolves to W=1 (the reference's exact
+    split sequence) for those.  Explicit user values always pass
+    through.
     """
     w = int(config.tpu_wave_width)
     if w > 0:
@@ -103,6 +106,9 @@ def resolve_wave_width(config: Config, num_leaves: int) -> int:
     if w != -1:
         Log.fatal("tpu_wave_width must be positive or -1 (auto), got %d", w)
     if str(config.objective) in ("lambdarank", "rank"):
+        return 1
+    if str(config.boosting_type) in ("dart", "goss", "infinite",
+                                     "infiniteboost"):
         return 1
     if num_leaves <= 31:
         return 8

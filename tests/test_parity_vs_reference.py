@@ -2,8 +2,9 @@
 
 PARITY_TRAINING.json holds head-to-head metrics produced by
 tools/gen_parity.py (reference CLI and lightgbm_tpu trained on the golden
-data with identical configs, same metric code on both prediction sets —
-the docs/GPU-Performance.md:134-145 CPU-vs-GPU accuracy pattern).
+data AND deterministic synthetic sets with identical configs, same metric
+code on both prediction sets — the docs/GPU-Performance.md:134-145
+CPU-vs-GPU accuracy pattern).
 
 This test retrains OUR side and asserts (a) we still reproduce our own
 committed numbers (training determinism / no silent regression) and
@@ -27,8 +28,10 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 from parity_metrics import load_query, load_tsv  # noqa: E402
 
 # |ours - reference| bound for exact (leaf-wise) growth; the committed
-# table (PARITY_TRAINING.md) shows actual deltas <= 8e-4
-EXACT_TOL = 2e-3
+# table (PARITY_TRAINING.md) shows actual deltas <= 1.5e-3 except GOSS,
+# whose gradient-sampling RNG consumption differs legitimately from the
+# reference's (our committed GOSS quality is BETTER on both metrics)
+REF_TOL = {"default": 2e-3, "dart": 3e-3, "goss": 2.5e-2}
 # reproducibility bound vs our own committed numbers (fp noise only)
 SELF_TOL = 5e-6
 
@@ -40,23 +43,52 @@ def _committed():
 
 
 @pytest.mark.parametrize("task", ["binary", "regression", "multiclass",
-                                  "lambdarank"])
+                                  "lambdarank", "dart", "goss",
+                                  "infiniteboost"])
 def test_training_quality_parity(task):
-    from gen_parity import TASKS, run_ours
+    from gen_parity import TASKS, _data_paths, run_ours
     table = _committed()[task]
     spec = TASKS[task]
-    y, _ = load_tsv(os.path.join(GOLDEN, "%s.test" % task))
-    qpath = os.path.join(GOLDEN, "%s.test.query" % task)
+    train, test = _data_paths(task, spec, {})
+    y, _ = load_tsv(test)
+    qpath = test + ".query"
     q = load_query(qpath) if os.path.exists(qpath) else None
     with tempfile.TemporaryDirectory() as tmp:
-        pred = run_ours(task, spec, tmp)
+        pred = run_ours(task, spec, tmp, train, test)
     got = spec["metrics"](y, pred, q)
+    tol = REF_TOL.get(task, REF_TOL["default"])
     for metric, ref_val in table["reference"].items():
         mine = got[metric]
         committed_mine = table["lightgbm_tpu"][metric]
         assert abs(mine - committed_mine) < SELF_TOL, (
             "%s/%s drifted from committed value: %.6f vs %.6f"
             % (task, metric, mine, committed_mine))
-        assert abs(mine - ref_val) < EXACT_TOL, (
+        assert abs(mine - ref_val) < tol, (
             "%s/%s out of parity with reference: %.6f vs %.6f"
             % (task, metric, mine, ref_val))
+
+
+def test_sparse_synthetic_parity_pin():
+    """The 95%-sparse synthetic task, both engines: the dense default and
+    the tpu_sparse device store must reproduce their committed numbers,
+    and the sparse store must stay within tolerance of the committed
+    reference (its committed logloss delta is 1.5e-6 — the store mirrors
+    the reference's SparseBin behavior almost exactly)."""
+    from gen_parity import SYNTHETIC_TASKS, _gen_synthetic, run_ours
+    table = _committed()["sparse95"]
+    spec = SYNTHETIC_TASKS["sparse95"]
+    with tempfile.TemporaryDirectory() as tmp:
+        train, test = _gen_synthetic(tmp)["sparse95"]
+        y, _ = load_tsv(test)
+        pred = run_ours("sparse95", spec, tmp, train, test)
+        pred_sp = run_ours("sparse95", spec, tmp, train, test,
+                           spec["extra_arms"]["tpu_sparse"])
+    got = spec["metrics"](y, pred, None)
+    got_sp = spec["metrics"](y, pred_sp, None)
+    for metric in table["reference"]:
+        assert abs(got[metric]
+                   - table["lightgbm_tpu"][metric]) < SELF_TOL
+        assert abs(got_sp[metric]
+                   - table["lightgbm_tpu_tpu_sparse"][metric]) < SELF_TOL
+        assert abs(got_sp[metric]
+                   - table["reference"][metric]) < REF_TOL["default"]

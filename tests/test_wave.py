@@ -225,6 +225,14 @@ def test_wave_width_auto_ranking_quality_gate():
     cfg2 = Config({"verbose": -1, "objective": "lambdarank",
                    "tpu_wave_width": 16})
     assert resolve_wave_width(cfg2, 255) == 16
-    # non-ranking keeps the speed ladder
+    # DART/InfiniteBoost re-weighting compounds the order approximation
+    # (PARITY_TRAINING: +2.7e-2 / +2.5e-2 logloss at W=8) -> W=1 on auto
+    assert resolve_wave_width(Config({"verbose": -1, "objective": "binary",
+                                      "boosting_type": "dart"}), 255) == 1
+    assert resolve_wave_width(
+        Config({"verbose": -1, "boosting_type": "infiniteboost"}), 255) == 1
+    assert resolve_wave_width(
+        Config({"verbose": -1, "boosting_type": "goss"}), 255) == 1
+    # plain GBDT keeps the speed ladder
     assert resolve_wave_width(Config({"verbose": -1,
                                       "objective": "binary"}), 255) == 32
