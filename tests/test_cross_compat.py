@@ -1,0 +1,118 @@
+"""LIVE cross-framework model compatibility, both directions.
+
+tests/test_model_compat.py pins against COMMITTED reference model/pred
+files; this suite goes further when a reference binary exists
+($REF_LGBM or /tmp/refbuild/lightgbm, built unmodified from
+/root/reference): models trained HERE are loaded and predicted by the
+reference CLI, and models trained by the reference are loaded and
+predicted here — predictions must agree.  The text model format is the
+compatibility surface (GBDT::SaveModelToString, gbdt.cpp:817-861).
+
+Skipped automatically when no binary is present (the CI image builds one
+in round tooling; any user can `cmake && make` the reference).
+"""
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, "data", "golden")
+REF_BIN = os.environ.get("REF_LGBM", "/tmp/refbuild/lightgbm")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(REF_BIN),
+    reason="no reference binary (set REF_LGBM or build /tmp/refbuild)")
+
+
+def _ref(args, cwd):
+    proc = subprocess.run([REF_BIN] + args, cwd=cwd,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "reference CLI failed (rc=%d):\n%s\n%s"
+        % (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:]))
+
+
+def _ours(args):
+    from lightgbm_tpu import cli
+    cli.main(args)
+
+
+CONFIGS = {
+    "binary": ["objective=binary", "num_trees=25", "num_leaves=15",
+               "max_bin=63", "min_data_in_leaf=5"],
+    "regression": ["objective=regression", "num_trees=25",
+                   "num_leaves=15", "max_bin=63", "min_data_in_leaf=5"],
+    "multiclass": ["objective=multiclass", "num_class=3", "num_trees=15",
+                   "num_leaves=15", "max_bin=63", "min_data_in_leaf=5"],
+}
+
+
+@pytest.mark.parametrize("task", sorted(CONFIGS))
+def test_our_model_predicts_identically_in_reference(task):
+    train = os.path.join(GOLDEN, "%s.train" % task)
+    test = os.path.join(GOLDEN, "%s.test" % task)
+    with tempfile.TemporaryDirectory() as tmp:
+        model = os.path.join(tmp, "m.txt")
+        ours_pred = os.path.join(tmp, "ours.pred")
+        ref_pred = os.path.join(tmp, "ref.pred")
+        _ours(["task=train", "data=%s" % train, "output_model=%s" % model,
+               "verbosity=-1"] + CONFIGS[task])
+        _ours(["task=predict", "data=%s" % test, "input_model=%s" % model,
+               "output_result=%s" % ours_pred, "verbosity=-1"])
+        _ref(["task=predict", "data=%s" % test, "input_model=%s" % model,
+              "output_result=%s" % ref_pred, "verbosity=-1"], tmp)
+        a = np.loadtxt(ours_pred)
+        b = np.loadtxt(ref_pred)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
+
+
+@pytest.mark.parametrize("task", sorted(CONFIGS))
+def test_reference_model_predicts_identically_here(task):
+    train = os.path.join(GOLDEN, "%s.train" % task)
+    test = os.path.join(GOLDEN, "%s.test" % task)
+    with tempfile.TemporaryDirectory() as tmp:
+        model = os.path.join(tmp, "m.txt")
+        ours_pred = os.path.join(tmp, "ours.pred")
+        ref_pred = os.path.join(tmp, "ref.pred")
+        _ref(["task=train", "data=%s" % train, "output_model=%s" % model,
+              "verbosity=-1"] + CONFIGS[task], tmp)
+        _ref(["task=predict", "data=%s" % test, "input_model=%s" % model,
+              "output_result=%s" % ref_pred, "verbosity=-1"], tmp)
+        _ours(["task=predict", "data=%s" % test, "input_model=%s" % model,
+               "output_result=%s" % ours_pred, "verbosity=-1"])
+        a = np.loadtxt(ours_pred)
+        b = np.loadtxt(ref_pred)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
+
+
+def test_continued_training_across_frameworks():
+    """Reference trains 10 trees -> we continue 10 more from its model
+    file -> the combined model still loads and predicts in the
+    reference (input_model continuation, boosting.cpp:43-62 /
+    engine.py:92-98)."""
+    train = os.path.join(GOLDEN, "binary.train")
+    test = os.path.join(GOLDEN, "binary.test")
+    base = [p for p in CONFIGS["binary"]
+            if not p.startswith(("objective=", "num_trees="))]
+    with tempfile.TemporaryDirectory() as tmp:
+        m1 = os.path.join(tmp, "m1.txt")
+        m2 = os.path.join(tmp, "m2.txt")
+        ours_pred = os.path.join(tmp, "ours.pred")
+        ref_pred = os.path.join(tmp, "ref.pred")
+        _ref(["task=train", "data=%s" % train, "output_model=%s" % m1,
+              "objective=binary", "num_trees=10", "verbosity=-1"] + base,
+             tmp)
+        _ours(["task=train", "data=%s" % train, "input_model=%s" % m1,
+               "output_model=%s" % m2, "objective=binary", "num_trees=10",
+               "verbosity=-1"] + base)
+        _ours(["task=predict", "data=%s" % test, "input_model=%s" % m2,
+               "output_result=%s" % ours_pred, "verbosity=-1"])
+        _ref(["task=predict", "data=%s" % test, "input_model=%s" % m2,
+              "output_result=%s" % ref_pred, "verbosity=-1"], tmp)
+        a = np.loadtxt(ours_pred)
+        b = np.loadtxt(ref_pred)
+        assert len(a) == len(b)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
