@@ -211,10 +211,17 @@ class SerialTreeLearner:
         # Serial exact engine only; the wave engine keeps the dense store.
         from ..utils.config import _FALSE_SET, _TRUE_SET
         serial_learner = str(config.tree_learner) in ("serial",)
+        # the data-parallel learner shards the coordinate store by row
+        # blocks itself (parallel/mesh.py); feature/voting keep dense
+        dp_learner = (psum_axis is not None
+                      and str(config.tree_learner)
+                      in ("data", "data_parallel"))
         sparse_on = bool(config.tpu_sparse)
-        if sparse_on and (psum_axis is not None or not serial_learner):
+        if sparse_on and not ((serial_learner and psum_axis is None)
+                              or dp_learner):
             Log.warning("tpu_sparse=true ignored: the sparse device store "
-                        "supports the serial learner only")
+                        "supports the serial and data-parallel learners "
+                        "only")
             sparse_on = False
         if sparse_on:
             if hist_mode.startswith("pallas"):
@@ -285,7 +292,12 @@ class SerialTreeLearner:
         # sizes land on the same compiled shape; pad rows carry zero
         # row_mult and change nothing)
         self._row_pad = device_row_pad
-        if sparse_on:
+        if sparse_on and psum_axis is not None:
+            # data-parallel: the mesh learner replaces X with its
+            # row-block coordinate stores after this ctor; keep the
+            # dense device_data meanwhile
+            self.X = device_data
+        elif sparse_on:
             from .sparse_store import (SparseDeviceStore,
                                        build_sparse_store,
                                        column_fill_bins)
@@ -382,6 +394,11 @@ class SerialTreeLearner:
                 return _core(X, g, h, rm, m, _meta, _bund)
 
             self._grow = _grow
+        elif sparse_on:
+            # the data-parallel mesh subclass owns the sparse grow (it
+            # has the col_cap and the sharded store); a base fallback
+            # with col_cap=0 would silently misroute every partition
+            self._grow = None
         else:
             # the distributed base fallback is the exact engine; the
             # wave-only pallas_t kernel maps to onehot here — mesh

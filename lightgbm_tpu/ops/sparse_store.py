@@ -42,14 +42,10 @@ class SparseDeviceStore(NamedTuple):
     fill: jnp.ndarray       # (F,) i32 per-column fill bin
 
 
-def build_sparse_store(binned: np.ndarray, fill: np.ndarray,
-                       num_bins: int):
-    """Host-side build from the (N, F) binned matrix.
+def _store_arrays(binned: np.ndarray, fill: np.ndarray, num_bins: int):
+    """Pure-numpy coordinate arrays for one row block.
 
-    Returns (store, col_cap, device_bytes).  ``fill`` must be the
-    per-column bin slot that the histogram view reconstructs (or never
-    reads) — entries equal to it are dropped.
-    """
+    Returns ((nz_row, nz_bin, nz_seg, colptr, fill_i32), col_cap)."""
     n, f = binned.shape
     mask_t = (binned != fill[None, :]).T          # (F, N) column-major walk
     cols, rows = np.nonzero(mask_t)               # sorted by col, then row
@@ -58,14 +54,68 @@ def build_sparse_store(binned: np.ndarray, fill: np.ndarray,
     colptr = np.zeros(f + 1, np.int64)
     np.cumsum(counts, out=colptr[1:])
     col_cap = int(counts.max()) if f else 0
+    arrays = (rows.astype(np.int32), bins,
+              (cols * num_bins + bins).astype(np.int32),
+              colptr.astype(np.int32), fill.astype(np.int32))
+    return arrays, col_cap
+
+
+def build_sparse_store(binned: np.ndarray, fill: np.ndarray,
+                       num_bins: int):
+    """Host-side build from the (N, F) binned matrix.
+
+    Returns (store, col_cap, device_bytes).  ``fill`` must be the
+    per-column bin slot that the histogram view reconstructs (or never
+    reads) — entries equal to it are dropped.
+    """
+    (rows, bins, segs, colptr, fill_i), col_cap = \
+        _store_arrays(binned, fill, num_bins)
     store = SparseDeviceStore(
-        nz_row=jnp.asarray(rows.astype(np.int32)),
-        nz_bin=jnp.asarray(bins),
-        nz_seg=jnp.asarray((cols * num_bins + bins).astype(np.int32)),
-        colptr=jnp.asarray(colptr.astype(np.int32)),
-        fill=jnp.asarray(fill.astype(np.int32)),
+        nz_row=jnp.asarray(rows), nz_bin=jnp.asarray(bins),
+        nz_seg=jnp.asarray(segs), colptr=jnp.asarray(colptr),
+        fill=jnp.asarray(fill_i),
     )
-    device_bytes = 4 * (3 * len(rows) + f + 1 + f)
+    device_bytes = 4 * (3 * len(rows) + len(colptr) + len(fill_i))
+    return store, col_cap, device_bytes
+
+
+def build_sharded_store(binned: np.ndarray, fill: np.ndarray,
+                        num_bins: int, n_shards: int):
+    """Per-row-block stores for the data-parallel mesh, flat-concatenated.
+
+    The padded (N, F) matrix is split into ``n_shards`` equal row blocks;
+    each block gets its own coordinate store with LOCAL row ids.  Every
+    per-shard section is padded to the same length (segment ids of padded
+    entries point one past the histogram, so segment_sum drops them), and
+    the sections are concatenated so a ``P(DATA_AXIS)`` sharding hands
+    each device exactly its local store.  Returns (store, col_cap,
+    device_bytes) like build_sparse_store.
+    """
+    n, f = binned.shape
+    assert n % n_shards == 0, (n, n_shards)
+    block = n // n_shards
+    # pure numpy throughout: the caller uploads the concatenation ONCE
+    # (no per-shard device round-trips)
+    parts = [_store_arrays(binned[s * block:(s + 1) * block], fill,
+                           num_bins)
+             for s in range(n_shards)]
+    nnz_max = max(max(len(p[0][0]) for p in parts), 1)
+    col_cap = max(p[1] for p in parts)
+    drop_seg = f * num_bins          # out of range => dropped by segment_sum
+
+    def pad_to(arr, value):
+        out = np.full(nnz_max, value, arr.dtype)
+        out[:len(arr)] = arr
+        return out
+
+    store = SparseDeviceStore(
+        nz_row=np.concatenate([pad_to(p[0][0], 0) for p in parts]),
+        nz_bin=np.concatenate([pad_to(p[0][1], 0) for p in parts]),
+        nz_seg=np.concatenate([pad_to(p[0][2], drop_seg) for p in parts]),
+        colptr=np.concatenate([p[0][3] for p in parts]),
+        fill=np.concatenate([p[0][4] for p in parts]),
+    )
+    device_bytes = 4 * (3 * n_shards * nnz_max + n_shards * (2 * f + 1))
     return store, col_cap, device_bytes
 
 

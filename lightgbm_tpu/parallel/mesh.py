@@ -134,9 +134,40 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if pad:
             binned = np.concatenate(
                 [binned, np.zeros((pad, binned.shape[1]), binned.dtype)])
+        if bool(config.tpu_sparse) and self._nproc > 1:
+            # per-process stores would need a cross-process nnz-capacity
+            # agreement; keep the dense store there for now
+            Log.warning("tpu_sparse=true ignored under multi-process "
+                        "training; using the dense device store")
+            config = config.copy_with(tpu_sparse=False)
         X_dev = make_row_sharded(self.mesh, binned, extra_dims=1)
         super().__init__(config, train_data, psum_axis=DATA_AXIS,
                          device_data=X_dev)
+        # GLOBAL row count: every process contributes n+pad rows
+        self._global_rows = (n + pad) * self._nproc
+        if self.sparse_on:
+            # row-block coordinate stores, flat-concatenated so
+            # P(DATA_AXIS) hands each device its local store with LOCAL
+            # row ids (ops/sparse_store.py build_sharded_store)
+            from ..ops.sparse_store import (SparseDeviceStore,
+                                            build_sharded_store,
+                                            column_fill_bins)
+            nbins_dev = (self.group_bins
+                         if train_data.bundle is not None
+                         else self.num_bins)
+            sp_binned = binned
+            if sp_binned.shape[1] == 0:
+                sp_binned = np.zeros((n + pad, 1), np.uint8)
+                fill = np.zeros(1, np.int64)
+            else:
+                fill = column_fill_bins(train_data.num_bin_arr,
+                                        train_data.default_bin_arr,
+                                        train_data.bundle)
+            host_store, self.sparse_col_cap, self.sparse_device_bytes = \
+                build_sharded_store(sp_binned, fill, nbins_dev, n_shards)
+            self.X = SparseDeviceStore(*[
+                make_row_sharded(self.mesh, np.asarray(leaf))
+                for leaf in host_store])
         self._row_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self._ones = make_row_sharded(
             self.mesh,
@@ -172,10 +203,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
                                 group_bins=self.group_bins,
                                 row_capacities=caps,
                                 cache_hists=self.cache_hists,
+                                sparse_col_cap=self.sparse_col_cap,
                                 **self._grow_kwargs(n_shards))
+        if self.sparse_on:
+            from ..ops.sparse_store import SparseDeviceStore
+            x_spec = SparseDeviceStore(*([P(DATA_AXIS)] * 5))
+        else:
+            x_spec = P(DATA_AXIS, None)
         sharded_grow = _shard_map_compat(
             grow, mesh=self.mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+            in_specs=(x_spec, P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P()),
             out_specs=(jax.tree_util.tree_map(lambda _: P(),
                                               self._dummy_tree_spec()),
@@ -194,7 +231,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
     def _pad_rows_dev(self, arr, fill=0.0):
         if isinstance(arr, jax.Array) and arr.ndim == 1 \
-                and arr.shape[0] == self.X.shape[0] \
+                and arr.shape[0] == self._global_rows \
                 and arr.dtype == self.dtype:
             return arr          # already a (global) row-sharded device array
         if self._nproc == 1:

@@ -347,8 +347,8 @@ class Config:
         "tpu_bin_pack": ("str", "auto"),
         # device-side sparse bin storage (ops/sparse_store.py, SparseBin
         # analog): per-leaf histograms become one segment_sum over the
-        # nonzero entries instead of an O(N*F) dense pass.  Serial exact
-        # engine only; default dense.
+        # nonzero entries instead of an O(N*F) dense pass.  Exact engine
+        # under the serial and data-parallel learners; default dense.
         "tpu_sparse": ("bool", False),
     }
 

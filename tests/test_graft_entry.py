@@ -34,6 +34,7 @@ def test_dryrun_multichip_from_clean_parent():
     assert "dryrun_multichip OK (feature-parallel)" in out
     assert "dryrun_multichip OK (voting-parallel)" in out
     assert "dryrun_multichip OK (data-parallel wave)" in out
+    assert "dryrun_multichip OK (data-parallel sparse)" in out
 
 
 def test_dryrun_child_guard_runs_inline(monkeypatch):

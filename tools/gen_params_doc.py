@@ -70,8 +70,9 @@ NOTES = {
                           "pallas_f histogram kernels",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
-    "tpu_sparse": "true / false — device-side sparse bin store (serial "
-                  "exact engine; histograms from nonzeros only)",
+    "tpu_sparse": "true / false — device-side sparse bin store (exact "
+                  "engine, serial + data-parallel; histograms from "
+                  "nonzeros only)",
     "tpu_use_dp": "float64 histograms/scores (gpu_use_dp analog)",
     "tpu_profile_dir": "write a jax.profiler trace per training run",
 }
