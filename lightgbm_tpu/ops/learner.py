@@ -210,15 +210,23 @@ class SerialTreeLearner:
         # segment_sum over nnz per leaf instead of an O(N*F) dense pass.
         # Serial exact engine only; the wave engine keeps the dense store.
         from ..utils.config import _FALSE_SET, _TRUE_SET
+        from .sparse_store import SparseDeviceStore as _SpStore
         serial_learner = str(config.tree_learner) in ("serial",)
+        # gate on the engine actually running, not the tree_learner
+        # string: a 'data'/'voting' config falling back to the serial
+        # engine on one device still gets the sparse store.  The
+        # feature-parallel subclass is the exception — it calls this
+        # ctor with psum_axis=None but a pre-sharded dense device_data.
+        true_serial = (psum_axis is None
+                       and (device_data is None
+                            or isinstance(device_data, _SpStore)))
         # the data-parallel learner shards the coordinate store by row
         # blocks itself (parallel/mesh.py); feature/voting keep dense
         dp_learner = (psum_axis is not None
                       and str(config.tree_learner)
                       in ("data", "data_parallel"))
         sparse_on = bool(config.tpu_sparse)
-        if sparse_on and not ((serial_learner and psum_axis is None)
-                              or dp_learner):
+        if sparse_on and not (true_serial or dp_learner):
             Log.warning("tpu_sparse=true ignored: the sparse device store "
                         "supports the serial and data-parallel learners "
                         "only")

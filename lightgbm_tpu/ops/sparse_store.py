@@ -79,29 +79,33 @@ def build_sparse_store(binned: np.ndarray, fill: np.ndarray,
     return store, col_cap, device_bytes
 
 
-def build_sharded_store(binned: np.ndarray, fill: np.ndarray,
+def sharded_store_parts(binned: np.ndarray, fill: np.ndarray,
                         num_bins: int, n_shards: int):
-    """Per-row-block stores for the data-parallel mesh, flat-concatenated.
+    """Phase 1 of the sharded build: per-row-block coordinate arrays.
 
-    The padded (N, F) matrix is split into ``n_shards`` equal row blocks;
-    each block gets its own coordinate store with LOCAL row ids.  Every
-    per-shard section is padded to the same length (segment ids of padded
-    entries point one past the histogram, so segment_sum drops them), and
-    the sections are concatenated so a ``P(DATA_AXIS)`` sharding hands
-    each device exactly its local store.  Returns (store, col_cap,
-    device_bytes) like build_sparse_store.
-    """
+    Returns (parts, nnz_needed, col_cap) — multi-process callers
+    allgather (nnz_needed, col_cap) and assemble with the global maxima
+    so every process pads its sections identically."""
     n, f = binned.shape
     assert n % n_shards == 0, (n, n_shards)
     block = n // n_shards
-    # pure numpy throughout: the caller uploads the concatenation ONCE
-    # (no per-shard device round-trips)
     parts = [_store_arrays(binned[s * block:(s + 1) * block], fill,
                            num_bins)
              for s in range(n_shards)]
-    nnz_max = max(max(len(p[0][0]) for p in parts), 1)
+    nnz_needed = max(max(len(p[0][0]) for p in parts), 1)
     col_cap = max(p[1] for p in parts)
-    drop_seg = f * num_bins          # out of range => dropped by segment_sum
+    return parts, nnz_needed, col_cap
+
+
+def assemble_sharded_store(parts, num_cols: int, num_bins: int,
+                           nnz_max: int):
+    """Phase 2: pad every per-shard section to ``nnz_max`` entries
+    (pad segments point one past the histogram, so segment_sum drops
+    them) and flat-concatenate, so a ``P(DATA_AXIS)`` sharding hands
+    each device exactly its local store.  Host numpy — the caller
+    uploads ONCE."""
+    n_shards = len(parts)
+    drop_seg = num_cols * num_bins
 
     def pad_to(arr, value):
         out = np.full(nnz_max, value, arr.dtype)
@@ -115,8 +119,9 @@ def build_sharded_store(binned: np.ndarray, fill: np.ndarray,
         colptr=np.concatenate([p[0][3] for p in parts]),
         fill=np.concatenate([p[0][4] for p in parts]),
     )
-    device_bytes = 4 * (3 * n_shards * nnz_max + n_shards * (2 * f + 1))
-    return store, col_cap, device_bytes
+    device_bytes = 4 * (3 * n_shards * nnz_max
+                        + n_shards * (2 * num_cols + 1))
+    return store, device_bytes
 
 
 def column_fill_bins(num_bin_arr, default_bin_arr, bundle) -> np.ndarray:

@@ -134,13 +134,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if pad:
             binned = np.concatenate(
                 [binned, np.zeros((pad, binned.shape[1]), binned.dtype)])
-        if bool(config.tpu_sparse) and self._nproc > 1:
-            # per-process stores would need a cross-process nnz-capacity
-            # agreement; keep the dense store there for now
-            Log.warning("tpu_sparse=true ignored under multi-process "
-                        "training; using the dense device store")
-            config = config.copy_with(tpu_sparse=False)
-        X_dev = make_row_sharded(self.mesh, binned, extra_dims=1)
+        # the sparse store replaces X below — don't upload (and orphan)
+        # the dense matrix when it will never be used.  Must mirror the
+        # base ctor's gate exactly (voting subclasses stay dense).
+        want_sparse = (bool(config.tpu_sparse)
+                       and str(config.tree_learner)
+                       in ("data", "data_parallel"))
+        X_dev = (None if want_sparse
+                 else make_row_sharded(self.mesh, binned, extra_dims=1))
         super().__init__(config, train_data, psum_axis=DATA_AXIS,
                          device_data=X_dev)
         # GLOBAL row count: every process contributes n+pad rows
@@ -148,10 +149,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self.sparse_on:
             # row-block coordinate stores, flat-concatenated so
             # P(DATA_AXIS) hands each device its local store with LOCAL
-            # row ids (ops/sparse_store.py build_sharded_store)
+            # row ids (ops/sparse_store.py).  Multi-process: every rank
+            # builds its OWN blocks and allgathers (nnz, col_cap) so all
+            # sections pad identically — the sparse analog of the
+            # distributed bin-mapper agreement (dataset_loader.cpp:768).
             from ..ops.sparse_store import (SparseDeviceStore,
-                                            build_sharded_store,
-                                            column_fill_bins)
+                                            assemble_sharded_store,
+                                            column_fill_bins,
+                                            sharded_store_parts)
             nbins_dev = (self.group_bins
                          if train_data.bundle is not None
                          else self.num_bins)
@@ -163,8 +168,17 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 fill = column_fill_bins(train_data.num_bin_arr,
                                         train_data.default_bin_arr,
                                         train_data.bundle)
-            host_store, self.sparse_col_cap, self.sparse_device_bytes = \
-                build_sharded_store(sp_binned, fill, nbins_dev, n_shards)
+            parts, nnz_needed, col_cap = sharded_store_parts(
+                sp_binned, fill, nbins_dev, local_shards)
+            if self._nproc > 1:
+                from .comm import JaxProcessComm
+                agreed = JaxProcessComm().allgather_obj(
+                    [int(nnz_needed), int(col_cap)])
+                nnz_needed = max(a[0] for a in agreed)
+                col_cap = max(a[1] for a in agreed)
+            host_store, self.sparse_device_bytes = assemble_sharded_store(
+                parts, sp_binned.shape[1], nbins_dev, nnz_needed)
+            self.sparse_col_cap = col_cap
             self.X = SparseDeviceStore(*[
                 make_row_sharded(self.mesh, np.asarray(leaf))
                 for leaf in host_store])

@@ -39,6 +39,7 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     coordinator, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dense"
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=nproc, process_id=rank)
     import jax.numpy as jnp
@@ -53,9 +54,13 @@ def main():
 
     assert jax.process_count() == nproc
     X_local, y_local = make_data(rank, nproc)
-    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5, "max_bin": 63,
-                  "verbose": -1, "tpu_growth": "exact",
-                  "enable_bundle": False})
+    cfg_keys = {"num_leaves": 15, "min_data_in_leaf": 5, "max_bin": 63,
+                "verbose": -1, "tpu_growth": "exact",
+                "enable_bundle": False}
+    if mode == "sparse":
+        # the sharded coordinate store with per-process nnz agreement
+        cfg_keys["tpu_sparse"] = True
+    cfg = Config(cfg_keys)
     comm = JaxProcessComm()
     # distributed bin finding across REAL processes (this also min-syncs
     # the RNG-bearing params automatically, application.cpp:118-199)

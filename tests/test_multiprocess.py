@@ -30,7 +30,7 @@ def _free_port():
     return port
 
 
-def _run_workers(nproc):
+def _run_workers(nproc, mode="dense"):
     coordinator = "127.0.0.1:%d" % _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own device count
@@ -46,7 +46,7 @@ def _run_workers(nproc):
             for r in range(nproc)]
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(HERE, "mp_worker.py"),
-         coordinator, str(nproc), str(r)],
+         coordinator, str(nproc), str(r), mode],
         env=env, cwd=REPO, stdout=logs[r], stderr=subprocess.STDOUT,
         text=True) for r in range(nproc)]
     try:
@@ -148,3 +148,17 @@ def test_two_process_data_parallel_training():
         diff = have != want
         assert diff.sum() <= 1 and np.abs(have - want)[diff].max(
             initial=0) <= 1, (have.tolist(), want.tolist())
+
+
+def test_two_process_sparse_store_matches_dense():
+    """tpu_sparse under REAL multi-process training: per-process
+    coordinate stores with an allgathered nnz/col_cap agreement must
+    produce the identical model on every rank AND the same trees as the
+    dense two-process run."""
+    sparse = _run_workers(2, mode="sparse")
+    assert sparse[0]["trees"] == sparse[1]["trees"]
+    dense = _run_workers(2)
+    for ts, td_ in zip(sparse[0]["trees"], dense[0]["trees"]):
+        assert ts["num_leaves"] == td_["num_leaves"]
+        assert ts["split_feature"] == td_["split_feature"]
+        assert ts["threshold_bin"] == td_["threshold_bin"]

@@ -289,3 +289,17 @@ def test_data_parallel_sparse_booster_end_to_end():
     p_sp = fit("true").predict(X)
     p_d = fit("false").predict(X)
     np.testing.assert_allclose(p_sp, p_d, rtol=2e-3, atol=2e-4)
+
+
+def test_single_device_fallback_keeps_sparse():
+    """tree_learner=data on a 1-device host falls back to the serial
+    ENGINE (create_tree_learner); the sparse gate keys on the engine,
+    so the store must survive the fallback."""
+    from lightgbm_tpu.ops.learner import SerialTreeLearner
+    X, y = make_sparse(n=800)
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1,
+                  "tree_learner": "data", "tpu_sparse": True})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    lr = SerialTreeLearner(cfg, td)      # the fallback construction
+    assert lr.sparse_on
+    assert isinstance(lr.X, SparseDeviceStore)
