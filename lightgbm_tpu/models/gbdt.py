@@ -121,6 +121,7 @@ class GBDT:
                 config, self.train_data, device_data=old.X,
                 device_sparse_col_cap=old.sparse_col_cap)
         elif (type(old) is SerialTreeLearner and not old_sparse
+                and not bool(config.tpu_sparse)   # sparse request rebuilds
                 and old.X.shape[0]
                 == self.train_data.num_data + old._row_pad):
             # reuse the uploaded (padded) bin matrix — no host->device

@@ -76,6 +76,14 @@ def _run_workers(nproc, mode="dense"):
     return results
 
 
+import pytest
+
+
+@pytest.fixture(scope="module")
+def dense_two_process():
+    return _run_workers(2)
+
+
 def test_four_process_ranks_agree():
     """4 OS processes x 2 virtual devices = an 8-device global mesh with
     three DCN hops; every rank must emerge with the identical model."""
@@ -85,8 +93,8 @@ def test_four_process_ranks_agree():
     assert all(t["num_leaves"] > 4 for t in trees[0])
 
 
-def test_two_process_data_parallel_training():
-    results = _run_workers(2)
+def test_two_process_data_parallel_training(dense_two_process):
+    results = dense_two_process
 
     # both processes must hold the identical model
     t0, t1 = results[0]["trees"], results[1]["trees"]
@@ -150,14 +158,14 @@ def test_two_process_data_parallel_training():
             initial=0) <= 1, (have.tolist(), want.tolist())
 
 
-def test_two_process_sparse_store_matches_dense():
+def test_two_process_sparse_store_matches_dense(dense_two_process):
     """tpu_sparse under REAL multi-process training: per-process
     coordinate stores with an allgathered nnz/col_cap agreement must
     produce the identical model on every rank AND the same trees as the
     dense two-process run."""
     sparse = _run_workers(2, mode="sparse")
     assert sparse[0]["trees"] == sparse[1]["trees"]
-    dense = _run_workers(2)
+    dense = dense_two_process
     for ts, td_ in zip(sparse[0]["trees"], dense[0]["trees"]):
         assert ts["num_leaves"] == td_["num_leaves"]
         assert ts["split_feature"] == td_["split_feature"]

@@ -1,0 +1,106 @@
+"""Drop-in check: the reference's OWN example configs, unmodified.
+
+The reference ships ready-to-run examples (train.conf/predict.conf +
+data, /root/reference/examples/*).  A user migrating to this framework
+should be able to run those files untouched — `config=train.conf` then
+`config=predict.conf` — and get the same quality.  Each example dir is
+copied to a temp dir (the reference tree is read-only; outputs land in
+the copy), our CLI runs both configs, and when a reference binary is
+present the SAME configs run there too and the test-split metrics must
+agree within the parity tolerance.
+
+Skipped wholesale when /root/reference is absent (user machines).
+"""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+EXAMPLES = "/root/reference/examples"
+REF_BIN = os.environ.get("REF_LGBM", "/tmp/refbuild/lightgbm")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(EXAMPLES), reason="reference examples not present")
+
+CASES = {
+    "binary_classification": ("binary.test", "auc"),
+    "regression": ("regression.test", "rmse"),
+    "multiclass_classification": ("multiclass.test", "multi_logloss"),
+    "lambdarank": ("rank.test", "ndcg@10"),
+}
+
+
+def _labels(test_path):
+    # first whitespace token per line — works for TSV and LibSVM alike
+    with open(test_path) as f:
+        return np.array([float(line.split(None, 1)[0])
+                         for line in f if line.strip()])
+
+
+def _metric(name, test_path, pred):
+    from parity_metrics import (auc, load_query, multi_logloss, ndcg_at,
+                                rmse)
+    y = _labels(test_path)
+    if name == "auc":
+        return auc(y, pred)
+    if name == "rmse":
+        return rmse(y, pred)
+    if name == "multi_logloss":
+        return multi_logloss(y, pred.reshape(len(y), -1))
+    q = load_query(test_path + ".query")
+    return ndcg_at(y, pred, q, 10)
+
+
+def _run_ours(workdir):
+    from lightgbm_tpu import cli
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        cli.main(["config=train.conf"])
+        cli.main(["config=predict.conf"])
+    finally:
+        os.chdir(cwd)
+
+
+def _run_reference(workdir):
+    for conf in ("train.conf", "predict.conf"):
+        proc = subprocess.run([REF_BIN, "config=%s" % conf], cwd=workdir,
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+@pytest.mark.parametrize("example", sorted(CASES))
+def test_reference_example_runs_unmodified(example):
+    test_file, metric = CASES[example]
+    with tempfile.TemporaryDirectory() as tmp:
+        work = os.path.join(tmp, "ours")
+        shutil.copytree(os.path.join(EXAMPLES, example), work)
+        _run_ours(work)
+        pred = np.loadtxt(os.path.join(work,
+                                       "LightGBM_predict_result.txt"))
+        ours = _metric(metric, os.path.join(work, test_file), pred)
+        assert np.isfinite(ours)
+        if not os.path.exists(REF_BIN):
+            return
+        ref_work = os.path.join(tmp, "ref")
+        shutil.copytree(os.path.join(EXAMPLES, example), ref_work)
+        _run_reference(ref_work)
+        ref_pred = np.loadtxt(os.path.join(
+            ref_work, "LightGBM_predict_result.txt"))
+        ref = _metric(metric, os.path.join(ref_work, test_file), ref_pred)
+        # the shipped examples are STOCHASTIC configs (feature_fraction
+        # 0.8, bagging 0.8 every 5 iters): both sides draw different but
+        # equally-valid subsamples from their RNGs, so metrics differ by
+        # sampling noise (measured ~6e-3 either direction; our binary
+        # AUC is the better one).  2e-2 still catches real breakage —
+        # deterministic-config parity is pinned tight in
+        # tests/test_parity_vs_reference.py.
+        assert abs(ours - ref) < 2e-2, (
+            "%s: ours=%.6f ref=%.6f" % (example, ours, ref))

@@ -303,3 +303,19 @@ def test_single_device_fallback_keeps_sparse():
     lr = SerialTreeLearner(cfg, td)      # the fallback construction
     assert lr.sparse_on
     assert isinstance(lr.X, SparseDeviceStore)
+
+
+def test_reset_parameter_can_enable_sparse():
+    """Enabling tpu_sparse via reset_parameter on a dense serial booster
+    must rebuild with the sparse store (the dense-matrix reuse path
+    steps aside for a sparse request)."""
+    X, y = make_sparse(n=1200)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 5}
+    bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    bst.update()
+    assert not isinstance(bst._gbdt.learner.X, SparseDeviceStore)
+    bst.reset_parameter({"tpu_sparse": True})
+    assert isinstance(bst._gbdt.learner.X, SparseDeviceStore)
+    bst.update()
+    assert np.isfinite(bst.predict(X)).all()
