@@ -234,11 +234,16 @@ class SerialTreeLearner:
         if sparse_on:
             if hist_mode.startswith("pallas"):
                 Log.fatal("tpu_sparse=true is incompatible with "
-                          "tpu_histogram_mode=%s", hist_mode)
-            if growth == "wave" and config.tpu_growth == "wave":
-                Log.warning("tpu_sparse=true forces tpu_growth=exact "
-                            "(the wave engine keeps the dense store)")
-            growth = "exact"
+                          "tpu_histogram_mode=%s (the pallas kernels are "
+                          "dense-only)", hist_mode)
+            # both engines take the store: exact scans nonzeros per
+            # split, wave amortizes the O(nnz) pass over W splits but
+            # pays W split-column materializations — measured SLOWER on
+            # the CPU mesh (BENCH_NOTES.md) and unproven on chip, so
+            # auto growth stays exact; an explicit tpu_growth=wave is
+            # honored
+            if str(config.tpu_growth) == "auto":
+                growth = "exact"
             hist_mode = "sparse"
             self.hist_mode = hist_mode
         self.sparse_on = sparse_on
@@ -274,12 +279,17 @@ class SerialTreeLearner:
         # tree_learner config (serial_learner above), not just the axis
         self.packed_cols = 0
         if ((pack_forced or pack_cfg == "auto") and pack_growth_ok
+                and not sparse_on
                 and psum_axis is None and serial_learner
                 and can_pack4(bins_per_col)):
             self.packed_cols = ncols
         elif pack_forced:
             reasons = []
-            if not pack_growth_ok:
+            if sparse_on:
+                reasons.append("the dense device store (tpu_sparse keeps "
+                               "coordinates, there are no bin bytes to "
+                               "pack)")
+            elif not pack_growth_ok:
                 reasons.append("wave growth or exact growth with the "
                                "onehot/scatter histogram kernels")
             if psum_axis is not None or not serial_learner:
@@ -368,7 +378,8 @@ class SerialTreeLearner:
                 config.max_depth, self.wave_width, self.dtype, None,
                 self.bundle_arrays is not None, self.group_bins,
                 self.cache_hists, hist_mode,
-                int(config.tpu_wave_chunk), self.packed_cols)
+                int(config.tpu_wave_chunk), self.packed_cols,
+                self.sparse_col_cap)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch

@@ -55,14 +55,14 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       psum_axis: str = None, bundle=None,
                       group_bins: int = 0, cache_hists: bool = True,
                       hist_mode: str = "onehot", chunk: int = 16384,
-                      packed_cols: int = 0):
+                      packed_cols: int = 0, sparse_col_cap: int = 0):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id))."""
     core = make_wave_core(num_leaves, num_bins, params, max_depth,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
-                          hist_mode, chunk, packed_cols)
+                          hist_mode, chunk, packed_cols, sparse_col_cap)
 
     def grow(X, grad, hess, row_mult, feature_mask):
         return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
@@ -84,15 +84,25 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    max_depth: int, wave_width: int, hist_dtype,
                    psum_axis: str, has_bundle: bool, group_bins: int,
                    cache_hists: bool, hist_mode: str, chunk: int,
-                   packed_cols: int = 0):
+                   packed_cols: int = 0, sparse_col_cap: int = 0):
     """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
     byte) and packed_cols is the LOGICAL column count; every chunk is
     unpacked in-scan so the full-width matrix never hits HBM (the
-    dense_nbits_bin.hpp:37 bandwidth halving, TPU form)."""
+    dense_nbits_bin.hpp:37 bandwidth halving, TPU form).
+
+    hist_mode == 'sparse': X is a SparseDeviceStore (ops/sparse_store.py)
+    and sparse_col_cap its per-column entry bound.  The wave then pays
+    O(nnz) per W splits instead of per split: the partition reads only
+    the W chosen split columns (materialized from the store), and ALL W
+    smaller-child histograms come from ONE segment_sum over the nonzero
+    entries with segment id ``slot*(F*B) + col*B + bin``."""
     L = num_leaves
     W = max(1, min(wave_width, L - 1))
     chunk = max(int(chunk), 256)      # guard tpu_wave_chunk<=0 etc.
     hist_bins = group_bins if has_bundle else num_bins
+    sparse_mode = hist_mode == "sparse"
+    if sparse_mode and packed_cols:
+        raise ValueError("tpu_sparse and 4-bit packing are exclusive")
     # the bin one-hot holds only 0/1 — exact in bf16 — and is the dominant
     # HBM traffic of the wave pass; on TPU the MXU also multiplies bf16
     # natively.  Weights and the accumulator stay in hist_dtype.
@@ -116,7 +126,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         return x
 
     def to_feature_hist(ghist, sums, meta, bundle):
-        return feature_hist_view(ghist, sums, meta, bundle, has_bundle)
+        return feature_hist_view(ghist, sums, meta, bundle, has_bundle,
+                                 fix_default=sparse_mode)
 
     # scatter-add serializes on TPU (~226ms vs onehot's 7.2ms at 1Mx28,
     # B=63) — only the explicit 'scatter' mode should pay it; the pallas
@@ -126,9 +137,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                     else leaf_histogram_onehot)
 
     def grow(X, grad, hess, row_mult, feature_mask, meta, bundle, Xt=None):
-        n = X.shape[0]
-        Fc = packed_cols or X.shape[1]    # LOGICAL group columns
-        Fdev = X.shape[1]                 # stored columns (packed: half)
+        n = grad.shape[0]       # X may be a SparseDeviceStore pytree
+        if sparse_mode:
+            Fc = Fdev = X.fill.shape[0]
+        else:
+            Fc = packed_cols or X.shape[1]    # LOGICAL group columns
+            Fdev = X.shape[1]                 # stored (packed: half)
         if packed_cols:
             from .pack import unpack4
             unpack = lambda xc: unpack4(xc, Fc)  # noqa: E731
@@ -146,14 +160,66 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         c = min(chunk, max(n, 1))
         pad = (-n) % c
         nch = (n + pad) // c
-        Xp = jnp.pad(X, ((0, pad), (0, 0))) if pad else X
-        xb = Xp.reshape(nch, c, Fdev)
+        if not sparse_mode:
+            Xp = jnp.pad(X, ((0, pad), (0, 0))) if pad else X
+            xb = Xp.reshape(nch, c, Fdev)
         # transposed matrix for the v2 kernel (MXU-native dot orientation):
         # callers that hold X for many trees pass a precomputed Xt (the
         # learner materializes it once per booster); otherwise fall back to
         # one (F, N) materialization per tree dispatch
         if use_pallas_hist and pallas_transposed and Xt is None:
             Xt = jnp.transpose(X)
+
+        # ---- sparse (coordinate-store) variants: partition reads ONLY
+        # the W chosen split columns; all W child histograms are ONE
+        # segment_sum over the nonzeros
+        def sparse_child_hists(lid, ids, valid):
+            slot_tbl = jnp.full(L, -1, jnp.int32).at[
+                jnp.where(valid, ids, L)].set(
+                    jnp.arange(W, dtype=jnp.int32), mode="drop")
+            leaf_nz = jnp.take(lid, X.nz_row)
+            slot = jnp.take(slot_tbl, leaf_nz)             # (nnz,)
+            wnz = jnp.take(w3, X.nz_row, axis=0)           # (nnz, 3)
+            # the sharded store pads sections with nz_seg == Fc*B (one
+            # past the histogram); the slot offset must not relocate
+            # those pads into the NEXT slot's valid range
+            real = (slot >= 0) & (X.nz_seg < Fc * hist_bins)
+            seg = jnp.where(real,
+                            slot * (Fc * hist_bins) + X.nz_seg,
+                            W * Fc * hist_bins)            # drop
+            flat = jax.ops.segment_sum(
+                wnz, seg, num_segments=W * Fc * hist_bins)
+            return flat.reshape(W, Fc, hist_bins, 3)
+
+        def route_rows(r, colv, lc):
+            """Split routing shared by the dense chunk scan and the
+            sparse pass: bundle remap, threshold compare, default-bin
+            redirect, right-child move (dense_bin.hpp:190-222)."""
+            if has_bundle:
+                goff = r[:, 7].astype(jnp.int32)
+                in_range = ((colv >= goff)
+                            & (colv < goff + r[:, 9].astype(jnp.int32)))
+                colv = jnp.where(in_range,
+                                 colv - goff + r[:, 8].astype(jnp.int32),
+                                 r[:, 4].astype(jnp.int32))
+            thr_r = r[:, 2].astype(jnp.int32)
+            gl = jnp.where(r[:, 3] > 0.5, colv == thr_r, colv <= thr_r)
+            gl = jnp.where(colv == r[:, 4].astype(jnp.int32),
+                           r[:, 5] > 0.5, gl)
+            active = r[:, 0] > 0.5
+            return jnp.where(active & ~gl, r[:, 6].astype(jnp.int32), lc)
+
+        def sparse_wave_pass(lid, tbl, small_id, valid, col_ids):
+            from .sparse_store import sparse_split_column
+            r = jnp.take(tbl, lid, axis=0)                 # (N, 10)
+            cj = r[:, 1].astype(jnp.int32)
+            colv = jnp.zeros(n, jnp.int32)
+            for w in range(W):                             # static W
+                vals = sparse_split_column(X, col_ids[w], n,
+                                           sparse_col_cap)
+                colv = jnp.where(cj == col_ids[w], vals, colv)
+            new_lid = route_rows(r, colv, lid)
+            return new_lid, sparse_child_hists(new_lid, small_id, valid)
 
         def pallas_hist(lid, cid):
             """Dispatch to the fused kernel in the configured layout —
@@ -204,23 +270,11 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 # thresholds, leaf ids) — the lookup must be exact f32
                 r = jnp.matmul(leaf_oh, tbl,
                                precision=lax.Precision.HIGHEST)  # (C, 10)
-                active = r[:, 0] > 0.5
                 cj = r[:, 1].astype(jnp.int32)
                 colv = jnp.sum(
                     jnp.where(cj[:, None] == f_iota[None, :], xc, 0)
                     .astype(jnp.int32), axis=1)     # (C,) split-column bin
-                if has_bundle:
-                    goff = r[:, 7].astype(jnp.int32)
-                    in_range = ((colv >= goff)
-                                & (colv < goff + r[:, 9].astype(jnp.int32)))
-                    colv = jnp.where(
-                        in_range, colv - goff + r[:, 8].astype(jnp.int32),
-                        r[:, 4].astype(jnp.int32))
-                thr_r = r[:, 2].astype(jnp.int32)
-                gl = jnp.where(r[:, 3] > 0.5, colv == thr_r, colv <= thr_r)
-                gl = jnp.where(colv == r[:, 4].astype(jnp.int32),
-                               r[:, 5] > 0.5, gl)
-                lc2 = jnp.where(active & ~gl, r[:, 6].astype(jnp.int32), lc)
+                lc2 = route_rows(r, colv, lc)
                 if not use_pallas_hist:
                     # child-masked weights: (C, W) match x (C, 3) channels
                     match = ((lc2[:, None] == small_id[None, :])
@@ -297,11 +351,16 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
         # ---- root
         root_sums = maybe_psum(jnp.sum(w3, axis=0))
-        root_kw = ({"chunk": chunk} if root_hist_fn is leaf_histogram_onehot
-                   else {})
-        hist0 = maybe_psum(root_hist_fn(X, grad, hess, leaf_id, 0, row_mult,
-                                        num_bins=hist_bins,
-                                        logical_cols=packed_cols, **root_kw))
+        if sparse_mode:
+            from .sparse_store import leaf_histogram_sparse
+            hist0 = maybe_psum(leaf_histogram_sparse(
+                X, grad, hess, leaf_id, 0, row_mult, hist_bins, Fc))
+        else:
+            root_kw = ({"chunk": chunk}
+                       if root_hist_fn is leaf_histogram_onehot else {})
+            hist0 = maybe_psum(root_hist_fn(
+                X, grad, hess, leaf_id, 0, row_mult, num_bins=hist_bins,
+                logical_cols=packed_cols, **root_kw))
         Fh, B = hist0.shape[0], hist0.shape[1]
         if cache_hists:
             hists = jnp.zeros((L, Fh, B, 3), hist_dtype).at[0].set(hist0)
@@ -388,7 +447,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             left_small = info[:, LEFT_COUNT] < info[:, RIGHT_COUNT]
             small_id = jnp.where(left_small, parent, newleaf)
             large_id = jnp.where(left_small, newleaf, parent)
-            leaf_id, hist_small = wave_pass(leaf_id, tbl, small_id, valid)
+            if sparse_mode:
+                leaf_id, hist_small = sparse_wave_pass(
+                    leaf_id, tbl, small_id, valid, col_w)
+            else:
+                leaf_id, hist_small = wave_pass(leaf_id, tbl, small_id,
+                                                valid)
             hist_small = maybe_psum(hist_small)             # (W, F, B, 3)
             if cache_hists:
                 hist_large = hists[parent] - hist_small
@@ -397,7 +461,9 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 lsrc = jnp.where(valid, large_id, L)
                 hists = hists.at[lsrc].set(hist_large, mode="drop")
             else:
-                hist_large = maybe_psum(rehist(leaf_id, large_id, valid))
+                hist_large = maybe_psum(
+                    sparse_child_hists(leaf_id, large_id, valid)
+                    if sparse_mode else rehist(leaf_id, large_id, valid))
 
             left_sums = jnp.stack([info[:, LEFT_SUM_G], info[:, LEFT_SUM_H],
                                    info[:, LEFT_COUNT]], axis=-1)

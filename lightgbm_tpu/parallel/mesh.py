@@ -202,7 +202,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 hist_dtype=self.dtype, psum_axis=DATA_AXIS,
                 bundle=self.bundle_arrays, group_bins=self.group_bins,
                 cache_hists=self.cache_hists, hist_mode=self.hist_mode,
-                chunk=int(config.tpu_wave_chunk))
+                chunk=int(config.tpu_wave_chunk),
+                sparse_col_cap=self.sparse_col_cap)
         else:
             if self.hist_mode in ("pallas_t", "pallas_f"):
                 Log.fatal("tpu_histogram_mode=%s is wave-only; the "

@@ -177,13 +177,14 @@ def test_sparse_bagging_and_weights():
 
 def test_sparse_gating():
     X, y = make_sparse(n=600)
-    # wave request is forced to exact
+    # the wave engine takes the store too (round 3: sparse wave)
     p = {"objective": "binary", "verbose": -1, "tpu_sparse": "true",
          "tpu_growth": "wave", "num_leaves": 7}
     bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
                     num_boost_round=2, verbose_eval=False)
-    assert bst._gbdt.learner.growth == "exact"
+    assert bst._gbdt.learner.growth == "wave"
     assert bst._gbdt.learner.sparse_on
+    assert isinstance(bst._gbdt.learner.X, SparseDeviceStore)
     # pallas modes are incompatible
     from lightgbm_tpu.utils.log import LightGBMError
     p2 = {"objective": "binary", "verbose": -1, "tpu_sparse": "true",
@@ -319,3 +320,119 @@ def test_reset_parameter_can_enable_sparse():
     assert isinstance(bst._gbdt.learner.X, SparseDeviceStore)
     bst.update()
     assert np.isfinite(bst.predict(X)).all()
+
+
+@pytest.mark.parametrize("wv", [1, 8])
+def test_sparse_wave_matches_dense_wave(wv):
+    """The wave engine over the coordinate store: partition reads only
+    the W chosen split columns and ALL W child histograms are one
+    segment_sum over the nonzeros — trees must match the dense wave
+    engine exactly."""
+    from lightgbm_tpu.ops.wave import make_wave_grow_fn
+    X, y = make_sparse(density=0.08)
+    cfg, td, meta, grad, hess = _setup(X, y, enable_bundle=False)
+    nb = int(td.num_bin_arr.max())
+    params = build_split_params(cfg)
+    ones = jnp.ones(len(y), jnp.float32)
+    fmask = jnp.ones(td.num_features, dtype=bool)
+    g0 = make_wave_grow_fn(31, nb, meta, params, cfg.max_depth,
+                           wave_width=wv, hist_mode="scatter")
+    t0, lid0 = g0(jnp.asarray(td.binned), grad, hess, ones, fmask)
+    fill = column_fill_bins(td.num_bin_arr, td.default_bin_arr, td.bundle)
+    store, cap, _ = build_sparse_store(td.binned, fill, nb)
+    g1 = make_wave_grow_fn(31, nb, meta, params, cfg.max_depth,
+                           wave_width=wv, hist_mode="sparse",
+                           sparse_col_cap=cap)
+    t1, lid1 = g1(store, grad, hess, ones, fmask)
+    _trees_match(t0, t1)
+    np.testing.assert_array_equal(np.asarray(lid0), np.asarray(lid1))
+
+
+def test_sparse_wave_booster_end_to_end():
+    X, y = make_sparse(n=2500)
+
+    def fit(sp):
+        p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+             "tpu_sparse": sp, "tpu_growth": "wave", "tpu_wave_width": 4,
+             "min_data_in_leaf": 5}
+        return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                         num_boost_round=4, verbose_eval=False)
+
+    p_sp = fit("true").predict(X)
+    p_d = fit("false").predict(X)
+    np.testing.assert_allclose(p_sp, p_d, rtol=2e-3, atol=2e-4)
+
+
+def test_data_parallel_sparse_wave():
+    """Sparse store + wave schedule + data mesh, all at once: the
+    per-wave psum'd histogram block comes from each shard's nonzeros."""
+    from lightgbm_tpu.parallel.mesh import DataParallelTreeLearner
+    X, y = make_sparse(n=2048, f=16, density=0.1, seed=11)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(len(y), 0.25, dtype=np.float32)
+
+    def run(sp):
+        cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5,
+                      "verbose": -1, "tree_learner": "data",
+                      "tpu_sparse": sp, "tpu_growth": "wave",
+                      "tpu_wave_width": 4, "enable_bundle": False})
+        td = TrainingData.from_matrix(X, label=y, config=cfg)
+        lr = DataParallelTreeLearner(cfg, td)
+        tree, leaf = lr.train(g, h)
+        return tree, np.asarray(leaf)
+
+    t_sp, l_sp = run("true")
+    t_d, l_d = run("false")
+    np.testing.assert_array_equal(np.asarray(t_sp.split_feature),
+                                  np.asarray(t_d.split_feature))
+    np.testing.assert_array_equal(np.asarray(t_sp.threshold_in_bin),
+                                  np.asarray(t_d.threshold_in_bin))
+    np.testing.assert_array_equal(l_sp, l_d)
+
+
+def test_sparse_wave_low_cardinality_skips_packing():
+    """max_bin<=15 + tpu_sparse + wave: the pack gate must skip packing
+    (coordinates have no bin bytes), not crash at construction."""
+    X, y = make_sparse(n=600)
+    p = {"objective": "binary", "verbose": -1, "tpu_sparse": "true",
+         "tpu_growth": "wave", "tpu_wave_width": 2, "num_leaves": 7,
+         "max_bin": 15, "tpu_bin_pack": "true"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=2, verbose_eval=False)
+    assert bst._gbdt.learner.packed_cols == 0
+    assert isinstance(bst._gbdt.learner.X, SparseDeviceStore)
+
+
+def test_data_parallel_sparse_wave_uneven_shards():
+    """Nonzeros concentrated in a few row blocks force LARGE per-shard
+    padding in the sharded store; pad entries must stay dropped even
+    with the wave's slot offset (regression: a pad's nz_seg == F*B
+    plus slot*(F*B) landed in the NEXT slot's first bin)."""
+    from lightgbm_tpu.parallel.mesh import DataParallelTreeLearner
+    rng = np.random.default_rng(13)
+    n, f = 2048, 12
+    X = np.zeros((n, f))
+    dense_rows = slice(0, n // 4)       # all the mass in the first blocks
+    X[dense_rows] = np.where(rng.random((n // 4, f)) < 0.5, 0.0,
+                             rng.normal(size=(n // 4, f)))
+    X[:, 0] = rng.normal(size=n)        # keep a learnable signal everywhere
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(n, 0.25, dtype=np.float32)
+
+    def run(sp):
+        cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5,
+                      "verbose": -1, "tree_learner": "data",
+                      "tpu_sparse": sp, "tpu_growth": "wave",
+                      "tpu_wave_width": 4, "enable_bundle": False})
+        td = TrainingData.from_matrix(X, label=y, config=cfg)
+        tree, leaf = DataParallelTreeLearner(cfg, td).train(g, h)
+        return tree, np.asarray(leaf)
+
+    t_sp, l_sp = run("true")
+    t_d, l_d = run("false")
+    np.testing.assert_array_equal(np.asarray(t_sp.split_feature),
+                                  np.asarray(t_d.split_feature))
+    np.testing.assert_array_equal(np.asarray(t_sp.threshold_in_bin),
+                                  np.asarray(t_d.threshold_in_bin))
+    np.testing.assert_array_equal(l_sp, l_d)
