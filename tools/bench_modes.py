@@ -21,13 +21,15 @@ def make_data(n_rows, n_features=28):
     return X, (logit > 0).astype(np.float64)
 
 
-def run(X, y, mode, wave_width=32, warmup=3, measured=10, iters_auc=13):
+def run(X, y, mode, wave_width=32, warmup=3, measured=10,
+        extra=None):
     import jax
     import lightgbm_tpu as lgb
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
               "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
               "metric": "auc", "tpu_growth": "wave",
               "tpu_wave_width": wave_width, "tpu_histogram_mode": mode}
+    params.update(extra or {})
     train_set = lgb.Dataset(X, label=y, params=params)
     bst = lgb.Booster(params=params, train_set=train_set)
     gbdt = bst._gbdt
