@@ -1,0 +1,157 @@
+"""Wedge-resilient TPU A/B: one subprocess per combo, probe between combos.
+
+The axon tunnel can wedge mid-run (observed rounds 2-3: a dispatch blocks
+forever with zero client CPU).  tpu_ab.py loses the whole run when that
+happens; this runner isolates every measurement in its own subprocess
+with a hard timeout, re-probes (with retries) before each one, appends
+each result to tools/AB_RESULTS.md the moment it lands, and keeps going
+past failures.  Combos are ordered most-valuable-first so a late wedge
+costs the least.
+
+Usage:  python tools/tpu_ab2.py [n_rows]            # full priority list
+        python tools/tpu_ab2.py --child <spec-json> # internal
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "tools", "AB_RESULTS.md")
+COMBO_TIMEOUT = 1500          # s per measurement subprocess
+PROBE_TIMEOUT = 90
+PROBE_RETRIES = 3             # short burst per pass; the outer loop re-visits
+PROBE_GAP = 60
+DEADLINE_S = 6 * 3600         # keep grinding up to 6h for a tunnel window
+
+
+def child(spec):
+    """Run one measurement in this (fresh) process; print one JSON line."""
+    import numpy as np
+    from tools.bench_modes import make_data, run
+    t0 = time.time()
+    if spec["kind"] == "dense":
+        X, y = make_data(spec["n"])
+        dt, auc = run(X, y, spec["mode"], wave_width=spec["width"],
+                      extra=spec.get("extra"))
+    else:  # bosch-shaped sparse
+        rng = np.random.default_rng(7)
+        ns, fs = spec["n"], 968
+        nnz = int(ns * fs * 0.01)
+        X = np.zeros((ns, fs), np.float32)
+        X[rng.integers(0, ns, nnz), rng.integers(0, fs, nnz)] = \
+            rng.normal(size=nnz)
+        y = (X[:, 0] + X[:, 1] > 0.02).astype(np.float64)
+        dt, auc = run(X, y, spec.get("mode", "auto"),
+                      wave_width=spec["width"], measured=5,
+                      extra=spec.get("extra"))
+    print(json.dumps({"dt": dt, "auc": auc, "wall": time.time() - t0}),
+          flush=True)
+
+
+def probe_with_retries():
+    from lightgbm_tpu.utils.common import probe_device
+    for attempt in range(PROBE_RETRIES):
+        try:
+            return probe_device(timeout=PROBE_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            print("  probe %d/%d timed out; retrying in %ds"
+                  % (attempt + 1, PROBE_RETRIES, PROBE_GAP), flush=True)
+            time.sleep(PROBE_GAP)
+        except RuntimeError as e:
+            print("  probe error: %s" % e, flush=True)
+            time.sleep(PROBE_GAP)
+    return None
+
+
+def append(line):
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 999_424
+    combos = [
+        ("engine pallas_f W=32",
+         {"kind": "dense", "n": n, "mode": "pallas_f", "width": 32}),
+        ("engine onehot   W=64",
+         {"kind": "dense", "n": n, "mode": "onehot", "width": 64}),
+        ("engine pallas_t W=32",
+         {"kind": "dense", "n": n, "mode": "pallas_t", "width": 32}),
+        ("engine pallas   W=32",
+         {"kind": "dense", "n": n, "mode": "pallas", "width": 32}),
+        ("engine pallas_f W=64",
+         {"kind": "dense", "n": n, "mode": "pallas_f", "width": 64}),
+        ("bosch1Mx968 sparse exact",
+         {"kind": "sparse", "n": 1_000_000, "width": 1,
+          "extra": {"tpu_sparse": True, "tpu_growth": "exact"}}),
+        ("bosch1Mx968 sparse wave8",
+         {"kind": "sparse", "n": 1_000_000, "width": 8,
+          "extra": {"tpu_sparse": True, "tpu_growth": "wave"}}),
+        ("bosch1Mx968 dense  exact",
+         {"kind": "sparse", "n": 1_000_000, "width": 1,
+          "extra": {"tpu_growth": "exact"}}),
+    ]
+    stamp = datetime.datetime.now(datetime.timezone.utc)
+    append("\n## %s UTC — tpu_ab2 (wedge-resilient), n=%d"
+           % (stamp.isoformat(timespec="seconds"), n))
+    start = time.time()
+    pending = list(combos)
+    fail_counts = {name: 0 for name, _ in combos}
+    while pending and time.time() - start < DEADLINE_S:
+        still = []
+        for name, spec in pending:
+            if time.time() - start >= DEADLINE_S:
+                still.append((name, spec))
+                continue
+            backend = probe_with_retries()
+            if backend is None:
+                print("  device unreachable; will re-try %r next pass"
+                      % name, flush=True)
+                still.append((name, spec))
+                continue
+            t0 = time.time()
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--child",
+                     json.dumps(spec)],
+                    capture_output=True, text=True, timeout=COMBO_TIMEOUT,
+                    cwd=REPO)
+                if r.returncode != 0:
+                    raise RuntimeError(r.stderr.strip().splitlines()[-1]
+                                       if r.stderr.strip() else
+                                       "rc=%d" % r.returncode)
+                res = json.loads(r.stdout.strip().splitlines()[-1])
+                append("    %-26s: %.3f s/iter (%.2f it/s) auc=%.4f "
+                       "[wall %.0fs]"
+                       % (name, res["dt"], 1.0 / res["dt"], res["auc"],
+                          time.time() - t0))
+            except subprocess.TimeoutExpired:
+                fail_counts[name] += 1
+                if fail_counts[name] >= 2:
+                    append("    %-26s: TIMEOUT x%d after %ds each — giving up"
+                           % (name, fail_counts[name], COMBO_TIMEOUT))
+                else:
+                    print("  %s timed out (attempt %d); re-queued"
+                          % (name, fail_counts[name]), flush=True)
+                    still.append((name, spec))
+            except Exception as e:
+                # real failures (Mosaic rejection etc.) are data — record
+                append("    %-26s: FAILED (%s)" % (name, e))
+        pending = still
+        if pending:
+            time.sleep(120)
+    for name, _ in pending:
+        append("    %-26s: UNMEASURED (device never reachable)" % name)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(json.loads(sys.argv[2]))
+    else:
+        main()
