@@ -75,7 +75,13 @@ def probe_device(timeout: float = 90.0) -> str:
     """
     import subprocess
     import sys
-    code = ("import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); "
+    # honor JAX_PLATFORMS explicitly: the env var alone does not override
+    # the axon TPU platform, the config update before backend init does —
+    # this lets tests point the probe at the CPU platform
+    code = ("import os, jax, jax.numpy as jnp; "
+            "p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); "
+            "x = jnp.ones((128, 128)); "
             "print(jax.default_backend(), float(jnp.sum(x @ x)))")
     r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                        capture_output=True, text=True)

@@ -1,0 +1,53 @@
+"""End-to-end pin of the driver's bench artifact path.
+
+bench.py is the round artifact the driver runs on real hardware; rounds 1
+and 2 both lost it to tunnel failures the script didn't anticipate.  This
+test drives the FULL orchestrator (probe -> child subprocess -> one JSON
+line on stdout) on the CPU platform with a tiny recipe, so regressions in
+the wedge-handling plumbing show up in CI instead of in a red
+BENCH_r{N}.json.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_bench_orchestrator_end_to_end():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_ALLOW_CPU": "1",
+        "BENCH_ROWS": "20000",
+        "BENCH_WARMUP": "1",
+        "BENCH_MEASURED": "2",
+        "BENCH_DEADLINE_S": "900",
+        "BENCH_ATTEMPT_S": "600",
+    })
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=900,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0
+    assert rec["unit"] == "iters/sec"
+    # an overridden shape must not masquerade as the flagship artifact
+    assert "higgs20000x28" in rec["metric"]
+    assert rec["vs_baseline"] is None
+
+
+def test_bench_exits_cleanly_when_deadline_exhausted():
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "5"
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO, env=env)
+    assert r.returncode == 2
+    assert "deadline exhausted" in r.stderr
