@@ -100,6 +100,7 @@ def main():
     start = time.time()
     attempt = 0
     consec = {"error": 0, "mismatch": 0, "childfail": 0}
+    last_child_rc = None
     while True:
         attempt += 1
         left = deadline - (time.time() - start)
@@ -145,6 +146,9 @@ def main():
                                      % (stream, data[-1000:]))
             print("bench: attempt %d timed out (tunnel wedge?); retrying"
                   % attempt, file=sys.stderr, flush=True)
+            # a wedge breaks any "same deterministic failure" chain
+            consec["childfail"] = 0
+            last_child_rc = None
             continue
         out = [ln for ln in r.stdout.strip().splitlines()
                if ln.startswith("{")]
@@ -152,10 +156,13 @@ def main():
             print(out[-1])   # the one JSON line
             return
         sys.stderr.write(r.stderr[-2000:])
-        consec["childfail"] += 1
+        consec["childfail"] = (consec["childfail"] + 1
+                               if r.returncode == last_child_rc else 1)
+        last_child_rc = r.returncode
         if consec["childfail"] >= 2:
-            # same deterministic failure twice (ImportError, learn-quality
-            # assert, ...) — more retries can't change it
+            # the SAME failure twice in a row with no wedge in between
+            # (ImportError, learn-quality assert, ...) — more retries
+            # can't change it
             print("bench: measurement failed deterministically (rc=%d)"
                   % r.returncode, file=sys.stderr, flush=True)
             sys.exit(1)
@@ -199,7 +206,8 @@ def child():
     # the metric name reflects the ACTUAL workload; the 0.133 it/s
     # baseline only denominates the flagship shape, so a leaked BENCH_*
     # override can't masquerade as the 10.5M number
-    flagship = (N_ROWS, N_FEATURES) == (10_500_000, 28)
+    flagship = (N_ROWS, N_FEATURES, WARMUP, MEASURED) == (10_500_000, 28,
+                                                          3, 10)
     shape = "higgs10p5Mx28" if flagship else "higgs%dx%d" % (N_ROWS,
                                                              N_FEATURES)
     print(json.dumps({

@@ -175,6 +175,31 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
     return ret
 
 
+class CVBooster:
+    """Container for the per-fold boosters of a cv run (engine.py:206-224).
+
+    Attribute access that isn't a field broadcasts the method call to every
+    fold's booster and returns the list of results, as the reference does:
+    ``cvb.predict(X)`` -> ``[b.predict(X) for b in cvb.boosters]``.
+    """
+
+    def __init__(self):
+        self.boosters = []
+        self.best_iteration = -1
+
+    def append(self, booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def handler(*args, **kwargs):
+            return [getattr(bst, name)(*args, **kwargs)
+                    for bst in self.boosters]
+        return handler
+
+
 def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
        stratified: bool = False, shuffle: bool = True, metrics=None, fobj=None,
        feval=None, init_model=None, feature_name="auto",
@@ -194,18 +219,20 @@ def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
     cvfolds = _make_n_folds(train_set, folds, nfold, params, seed,
                             fpreproc=fpreproc, stratified=stratified,
                             shuffle=shuffle)
-    boosters = []
+    cvbooster = CVBooster()
     for train_sub, valid_sub, tparam in cvfolds:
         bst = Booster(params=tparam, train_set=train_sub)
         bst.add_valid(valid_sub, "valid")
-        boosters.append(bst)
+        cvbooster.append(bst)
 
     best_iter = num_boost_round
     for i in range(num_boost_round):
         agg = collections.defaultdict(list)
-        for bst in boosters:
-            bst.update(fobj=fobj)
-            for (_, name, score, hb) in bst.eval_valid(feval):
+        # broadcast through CVBooster.__getattr__, as the reference's cv
+        # drives its folds (engine.py:398-401)
+        cvbooster.update(fobj=fobj)
+        for fold_evals in cvbooster.eval_valid(feval):
+            for (_, name, score, hb) in fold_evals:
                 agg[(name, hb)].append(score)
         one_result = {}
         for (name, hb), scores in agg.items():

@@ -7,6 +7,7 @@ model format.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -237,6 +238,18 @@ class LGBMModel(BaseEstimator):
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted")
         return self._Booster.feature_importance()
+
+    # deprecated method-form aliases kept for drop-in compatibility
+    # (sklearn.py:457-463)
+    def booster(self):
+        warnings.warn("Use attribute booster_ instead.",
+                      DeprecationWarning)
+        return self.booster_
+
+    def feature_importance(self):
+        warnings.warn("Use attribute feature_importances_ instead.",
+                      DeprecationWarning)
+        return self.feature_importances_
 
 
 class LGBMRegressor(LGBMModel, RegressorMixin):

@@ -212,6 +212,37 @@ def test_cv():
     assert len(res["binary_logloss-mean"]) == 10
 
 
+def test_cvbooster_broadcast():
+    """engine.CVBooster mirrors the reference container (engine.py:206-224):
+    .boosters holds the fold boosters and unknown attributes broadcast the
+    method call, returning one result per fold."""
+    from lightgbm_tpu.engine import CVBooster
+    X, y = make_binary()
+    cvb = CVBooster()
+    for seed in (1, 2, 3):
+        train = lgb.Dataset(X, label=y)
+        cvb.append(lgb.train({"objective": "binary", "verbose": -1,
+                              "seed": seed}, train, num_boost_round=3))
+    assert len(cvb.boosters) == 3
+    preds = cvb.predict(X)          # broadcast through __getattr__
+    assert len(preds) == 3 and all(p.shape == (len(y),) for p in preds)
+    assert cvb.best_iteration == -1
+
+
+def test_sklearn_deprecated_aliases():
+    import warnings
+    X, y = make_binary()
+    clf = lgb.LGBMClassifier(n_estimators=3, verbose=-1)
+    clf.fit(X, y)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert clf.booster() is clf.booster_
+        assert np.array_equal(clf.feature_importance(),
+                              clf.feature_importances_)
+    assert all(issubclass(x.category, DeprecationWarning) for x in w)
+    assert len(w) == 2
+
+
 def test_boosting_variants():
     X, y = make_binary()
     for boosting in ("dart", "goss"):
