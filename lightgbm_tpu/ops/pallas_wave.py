@@ -338,10 +338,16 @@ def _wave_fused_kernel(x_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
                          r[:, 4:5].astype(jnp.int32))
     thr = r[:, 2:3].astype(jnp.int32)
     is_cat = r[:, 3:4] > 0.5
-    gl = jnp.where(is_cat, colv == thr, colv <= thr)
-    gl = jnp.where(colv == r[:, 4:5].astype(jnp.int32), r[:, 5:6] > 0.5,
-                   gl)
-    lc2 = jnp.where(active & ~gl, r[:, 6:7].astype(jnp.int32), lc)
+    # Boolean-BRANCH selects lower to an i8->i1 arith.trunci that Mosaic
+    # rejects on v5e ("Unsupported target bitwidth for truncation");
+    # carry the go-left decision as f32 0/1 and compare at the end.
+    one, zero = jnp.float32(1.0), jnp.float32(0.0)
+    gl = jnp.where(is_cat,
+                   jnp.where(colv == thr, one, zero),
+                   jnp.where(colv <= thr, one, zero))
+    gl = jnp.where(colv == r[:, 4:5].astype(jnp.int32),
+                   jnp.where(r[:, 5:6] > 0.5, one, zero), gl)
+    lc2 = jnp.where(active & (gl < 0.5), r[:, 6:7].astype(jnp.int32), lc)
     lid_out_ref[:] = lc2
 
     # ---- child histograms from the UPDATED leaf ids

@@ -242,11 +242,14 @@ class LGBMModel(BaseEstimator):
     # deprecated method-form aliases kept for drop-in compatibility
     # (sklearn.py:457-463)
     def booster(self):
+        """Deprecated alias of :attr:`booster_` (emits DeprecationWarning)."""
         warnings.warn("Use attribute booster_ instead.",
                       DeprecationWarning)
         return self.booster_
 
     def feature_importance(self):
+        """Deprecated alias of :attr:`feature_importances_` (emits
+        DeprecationWarning)."""
         warnings.warn("Use attribute feature_importances_ instead.",
                       DeprecationWarning)
         return self.feature_importances_

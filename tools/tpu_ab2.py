@@ -68,6 +68,23 @@ def probe_with_retries():
     return None
 
 
+def _last_error_line(stderr, name, rc):
+    """Pick the actual exception line out of child stderr; dump the full
+    trace to tools/ab_err_<name>.log for diagnosis."""
+    text = (stderr or "").strip()
+    slug = "".join(c if c.isalnum() else "_" for c in name)
+    if text:
+        with open(os.path.join(REPO, "tools", "ab_err_%s.log" % slug),
+                  "w") as f:
+            f.write(text + "\n")
+    noise = ("For simplicity, JAX has removed", "Set JAX_TRACEBACK")
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln and not any(ln.startswith(p) for p in noise):
+            return ln[:300] + " [full: tools/ab_err_%s.log]" % slug
+    return "rc=%d" % rc
+
+
 def append(line):
     print(line, flush=True)
     with open(OUT, "a") as f:
@@ -117,15 +134,16 @@ def main():
                 continue
             t0 = time.time()
             try:
+                env = dict(os.environ)
+                env["JAX_TRACEBACK_FILTERING"] = "off"
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), "--child",
                      json.dumps(spec)],
                     capture_output=True, text=True, timeout=COMBO_TIMEOUT,
-                    cwd=REPO)
+                    cwd=REPO, env=env)
                 if r.returncode != 0:
-                    raise RuntimeError(r.stderr.strip().splitlines()[-1]
-                                       if r.stderr.strip() else
-                                       "rc=%d" % r.returncode)
+                    raise RuntimeError(_last_error_line(r.stderr, name,
+                                                        r.returncode))
                 res = json.loads(r.stdout.strip().splitlines()[-1])
                 append("    %-26s: %.3f s/iter (%.2f it/s) auc=%.4f "
                        "[wall %.0fs]"
