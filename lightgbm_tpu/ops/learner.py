@@ -163,9 +163,10 @@ class SerialTreeLearner:
         self.params = build_split_params(config)
         hist_mode = config.tpu_histogram_mode
         if hist_mode not in ("auto", "onehot", "scatter", "pallas",
-                             "pallas_t", "pallas_f"):
+                             "pallas_t", "pallas_f", "pallas_ft"):
             Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
-                      "scatter/pallas/pallas_t/pallas_f)", hist_mode)
+                      "scatter/pallas/pallas_t/pallas_f/pallas_ft)",
+                      hist_mode)
         if hist_mode == "auto":
             # measured on v5e (1M x 28, varying inputs to defeat dispatch
             # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
@@ -196,13 +197,14 @@ class SerialTreeLearner:
                       growth)
         if growth == "auto":
             # 'pallas' is the exact engine's per-leaf kernel; 'pallas_t'
-            # and 'pallas_f' exist only as wave kernels
-            if hist_mode in ("pallas_t", "pallas_f"):
+            # 'pallas_f' and 'pallas_ft' exist only as wave kernels
+            if hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
                 growth = "wave"
             else:
                 growth = ("wave" if jax.default_backend() == "tpu"
                           and hist_mode != "pallas" else "exact")
-        if growth == "exact" and hist_mode in ("pallas_t", "pallas_f"):
+        if growth == "exact" and hist_mode in ("pallas_t", "pallas_f",
+                                               "pallas_ft"):
             Log.fatal("tpu_histogram_mode=%s requires tpu_growth=wave "
                       "(this kernel is wave-only)" % hist_mode)
         # ---- sparse device store (SparseBin/OrderedSparseBin analog,
@@ -367,7 +369,8 @@ class SerialTreeLearner:
         # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (
             default_row_capacities(train_data.num_data + self._row_pad)
-            if hist_mode not in ("pallas", "pallas_t", "pallas_f", "sparse")
+            if hist_mode not in ("pallas", "pallas_t", "pallas_f",
+                                 "pallas_ft", "sparse")
             else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice
@@ -386,7 +389,7 @@ class SerialTreeLearner:
             # mirror make_wave_core's use_pallas_hist gate (TPU + f32) so
             # no dead (F, N) copy is pinned when the kernel won't run
             xt = (jnp.transpose(self.X)
-                  if hist_mode == "pallas_t"
+                  if hist_mode in ("pallas_t", "pallas_ft")
                   and jax.default_backend() == "tpu"
                   and self.dtype == jnp.float32 else None)
 
@@ -423,7 +426,9 @@ class SerialTreeLearner:
             # wave-only pallas_t kernel maps to onehot here — mesh
             # subclasses that run the wave schedule install their own
             # pallas_t-capable grow right after this constructor
-            base_mode = ("onehot" if hist_mode in ("pallas_t", "pallas_f")
+            base_mode = ("onehot"
+                         if hist_mode in ("pallas_t", "pallas_f",
+                                          "pallas_ft")
                          else hist_mode)
             self._grow = make_grow_fn(self.num_leaves, self.num_bins,
                                       self.meta, self.params,

@@ -116,9 +116,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # hi/lo weight split (manual rounding — Mosaic's cast truncates).
     use_pallas_hist = (jax.default_backend() == "tpu"
                        and hist_dtype == jnp.float32
-                       and hist_mode in ("pallas", "pallas_t", "pallas_f"))
-    pallas_transposed = hist_mode == "pallas_t"
-    pallas_fused = hist_mode == "pallas_f"
+                       and hist_mode in ("pallas", "pallas_t", "pallas_f",
+                                         "pallas_ft"))
+    # 'pallas_ft' routes from row-major X and contracts from X_t — it is
+    # both transposed (needs Xt, rehists via the v2 kernel) and fused
+    pallas_transposed = hist_mode in ("pallas_t", "pallas_ft")
+    pallas_fused = hist_mode in ("pallas_f", "pallas_ft")
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -248,6 +251,13 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             kernel — a single read of X per wave.
             """
             if use_pallas_hist and pallas_fused:
+                if pallas_transposed:
+                    from .pallas_wave import wave_partition_hist_pallas_ft
+                    return wave_partition_hist_pallas_ft(
+                        X, Xt, leaf_id, w3,
+                        jnp.where(valid, small_id, -1), tbl,
+                        hist_bins, bundled=has_bundle,
+                        logical_cols=packed_cols)
                 from .pallas_wave import wave_partition_hist_pallas
                 return wave_partition_hist_pallas(
                     X, leaf_id, w3, jnp.where(valid, small_id, -1), tbl,

@@ -205,7 +205,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 chunk=int(config.tpu_wave_chunk),
                 sparse_col_cap=self.sparse_col_cap)
         else:
-            if self.hist_mode in ("pallas_t", "pallas_f"):
+            if self.hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
                 Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                           "voting-parallel learner's exact engine does not "
                           "support it" % self.hist_mode)
@@ -339,7 +339,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             is_categorical=jnp.concatenate(
                 [jnp.asarray(train_data.is_categorical_arr, bool),
                  jnp.zeros(fpad, bool)]))
-        if self.hist_mode in ("pallas_t", "pallas_f"):
+        if self.hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
             Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                       "feature-parallel learner's exact engine does not "
                       "support it" % self.hist_mode)

@@ -319,9 +319,11 @@ class Config:
         # tpu-native additions
         "tpu_use_dp": ("bool", False),
         # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' |
-        # 'pallas_f' — histogram kernel ('pallas' = exact-engine per-leaf
-        # kernel, 'pallas_t' = wave kernel with MXU-native transposed
-        # operands, 'pallas_f' = fused partition+histogram wave kernel)
+        # 'pallas_f' | 'pallas_ft' — histogram kernel ('pallas' =
+        # exact-engine per-leaf kernel, 'pallas_t' = wave kernel with
+        # MXU-native transposed operands, 'pallas_f' = fused partition+
+        # histogram wave kernel, 'pallas_ft' = fused AND transposed —
+        # routing from row-major X, MXU contraction from X_t)
         "tpu_histogram_mode": ("str", "auto"),
         # 'auto' | 'exact' | 'wave' — growth schedule (ops/wave.py):
         # 'exact' is the reference's one-split-at-a-time leaf-wise order;

@@ -1,7 +1,7 @@
 """Wave-histogram Pallas kernels vs the XLA oracle (interpret mode, CPU).
 
-Covers both operand layouts (v1 row-major, v2 transposed) and the 4-bit
-packed input path of each.
+Covers all operand layouts (v1 row-major, v2 transposed, v3 fused,
+v4 fused+transposed) and the 4-bit packed input path of each.
 """
 import numpy as np
 import jax.numpy as jnp
@@ -40,7 +40,7 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft"])
 def test_pallas_wave_data_parallel_constructs(mode):
     """tree_learner=data + a wave-only pallas mode must reach the mesh
     wave branch (the base constructor's exact-engine fallback maps these
@@ -57,7 +57,7 @@ def test_pallas_wave_data_parallel_constructs(mode):
     assert bst.predict(X).shape == (1600,)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft"])
 def test_pallas_wave_mode_plumbing(mode):
     """Wave-only pallas modes resolve to wave growth and train (falling
     back to the einsum path off-TPU); exact growth rejects them."""
@@ -115,8 +115,10 @@ def _route_numpy(X, leaf_id, tbl):
     return np.where(active & ~gl, r[:, 6].astype(np.int32), leaf_id)
 
 
-def test_fused_kernel_matches_oracle():
-    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas
+@pytest.mark.parametrize("layout", ["v3", "v4"])
+def test_fused_kernel_matches_oracle(layout):
+    from lightgbm_tpu.ops.pallas_wave import (wave_partition_hist_pallas,
+                                              wave_partition_hist_pallas_ft)
 
     X, leaf_id, w3, cid, b = _data(n=2500, f=7, b=14, k=5, seed=9)
     L = 16
@@ -133,16 +135,24 @@ def test_fused_kernel_matches_oracle():
         jnp.asarray(cid), b))
     want_hist[np.asarray(cid) < 0] = 0.0
 
-    got_lid, got_hist = wave_partition_hist_pallas(
-        jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
-        jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True)
+    if layout == "v3":
+        got_lid, got_hist = wave_partition_hist_pallas(
+            jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True)
+    else:
+        got_lid, got_hist = wave_partition_hist_pallas_ft(
+            jnp.asarray(X), jnp.asarray(X.T), jnp.asarray(leaf_id),
+            jnp.asarray(w3), jnp.asarray(cid), jnp.asarray(tbl), b,
+            interpret=True)
     np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
     np.testing.assert_allclose(np.asarray(got_hist), want_hist,
                                rtol=5e-4, atol=5e-4)
 
 
-def test_fused_kernel_packed():
-    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas
+@pytest.mark.parametrize("layout", ["v3", "v4"])
+def test_fused_kernel_packed(layout):
+    from lightgbm_tpu.ops.pallas_wave import (wave_partition_hist_pallas,
+                                              wave_partition_hist_pallas_ft)
 
     X, leaf_id, w3, cid, b = _data(n=2000, f=9, b=15, seed=11)
     rng = np.random.default_rng(12)
@@ -155,10 +165,17 @@ def test_fused_kernel_packed():
         jnp.asarray(cid), b))
     want_hist[np.asarray(cid) < 0] = 0.0
     packed = pack4_host(X)
-    got_lid, got_hist = wave_partition_hist_pallas(
-        jnp.asarray(packed), jnp.asarray(leaf_id), jnp.asarray(w3),
-        jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True,
-        logical_cols=X.shape[1])
+    if layout == "v3":
+        got_lid, got_hist = wave_partition_hist_pallas(
+            jnp.asarray(packed), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True,
+            logical_cols=X.shape[1])
+    else:
+        got_lid, got_hist = wave_partition_hist_pallas_ft(
+            jnp.asarray(packed), jnp.asarray(packed.T),
+            jnp.asarray(leaf_id), jnp.asarray(w3), jnp.asarray(cid),
+            jnp.asarray(tbl), b, interpret=True,
+            logical_cols=X.shape[1])
     np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
     np.testing.assert_allclose(np.asarray(got_hist), want_hist,
                                rtol=5e-4, atol=5e-4)

@@ -8,8 +8,9 @@ each result to tools/AB_RESULTS.md the moment it lands, and keeps going
 past failures.  Combos are ordered most-valuable-first so a late wedge
 costs the least.
 
-Usage:  python tools/tpu_ab2.py [n_rows]            # full priority list
-        python tools/tpu_ab2.py --child <spec-json> # internal
+Usage:  python tools/tpu_ab2.py [n_rows]             # full priority list
+        python tools/tpu_ab2.py [n_rows] --followup  # round-3 second pass
+        python tools/tpu_ab2.py --child <spec-json>  # internal
 """
 import datetime
 import json
@@ -91,8 +92,28 @@ def append(line):
         f.write(line + "\n")
 
 
+FOLLOWUP = [
+    # round-3 second pass: the fused+transposed kernel (pallas_ft), the
+    # post-Mosaic-fix rerun of pallas_f W=32, and the W=64 arm of the
+    # current leader pallas_t
+    ("engine pallas_ft W=32",
+     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 32}),
+    ("engine pallas_ft W=64",
+     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 64}),
+    ("engine pallas_f W=32",
+     {"kind": "dense", "n": 0, "mode": "pallas_f", "width": 32}),
+    ("engine pallas_t W=64",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 64}),
+]
+
+
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 999_424
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 999_424
+    if "--followup" in sys.argv:
+        combos = [(name, dict(spec, n=n)) for name, spec in FOLLOWUP]
+        run_combos(combos, n)
+        return
     combos = [
         ("engine pallas_f W=32",
          {"kind": "dense", "n": n, "mode": "pallas_f", "width": 32}),
@@ -114,6 +135,10 @@ def main():
          {"kind": "sparse", "n": 1_000_000, "width": 1,
           "extra": {"tpu_growth": "exact"}}),
     ]
+    run_combos(combos, n)
+
+
+def run_combos(combos, n):
     stamp = datetime.datetime.now(datetime.timezone.utc)
     append("\n## %s UTC — tpu_ab2 (wedge-resilient), n=%d"
            % (stamp.isoformat(timespec="seconds"), n))
