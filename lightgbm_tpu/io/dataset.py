@@ -588,7 +588,11 @@ class TrainingData:
             arrays["query_boundaries"] = self.metadata.query_boundaries
         if self.metadata.init_score is not None:
             arrays["init_score"] = self.metadata.init_score
-        np.savez_compressed(filename, meta=json.dumps(meta), **arrays)
+        # write through a handle: np.savez_compressed(<str>) appends
+        # ".npz" to alien extensions, breaking the reference's
+        # save-to-any-name contract (e.g. "train.bin")
+        with open(filename, "wb") as f:
+            np.savez_compressed(f, meta=json.dumps(meta), **arrays)
 
     @classmethod
     def can_load_binary(cls, filename: str) -> bool:

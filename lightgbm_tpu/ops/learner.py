@@ -161,26 +161,55 @@ class SerialTreeLearner:
                 is_categorical=jnp.asarray(train_data.is_categorical_arr),
             )
         self.params = build_split_params(config)
+        from .pallas_wave import WAVE_ONLY_MODES, _bin_pad
         hist_mode = config.tpu_histogram_mode
-        if hist_mode not in ("auto", "onehot", "scatter", "pallas",
-                             "pallas_t", "pallas_f", "pallas_ft"):
+        if hist_mode not in (("auto", "onehot", "scatter", "pallas")
+                             + WAVE_ONLY_MODES):
             Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
                       "scatter/pallas/pallas_t/pallas_f/pallas_ft)",
                       hist_mode)
-        if hist_mode == "auto":
-            # measured on v5e (1M x 28, varying inputs to defeat dispatch
-            # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
-            # either — XLA's fused one-hot reduce is at the VPU roofline,
-            # scatter-add serializes.  On CPU the opposite holds.
-            hist_mode = ("onehot" if jax.default_backend() == "tpu"
-                         else "scatter")
         self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
-        self.hist_mode = hist_mode
         ncols = (len(train_data.bundle.num_group_bins)
                  if train_data.bundle is not None
                  else max(train_data.num_features, 1))
         nbins = self.group_bins if train_data.bundle is not None \
             else self.num_bins
+        if hist_mode == "auto":
+            # measured on v5e (1M x 28, varying inputs to defeat dispatch
+            # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
+            # either — XLA's fused one-hot reduce is at the VPU roofline,
+            # scatter-add serializes.  On CPU the opposite holds.
+            on_tpu = jax.default_backend() == "tpu"
+            # On-chip A/B at the 255-leaf recipe (tools/AB_RESULTS.md,
+            # 1M x 28): the transposed Pallas wave kernel (one-hot
+            # generated in VMEM, MXU-native dot) beats the XLA one-hot
+            # engine 6.60 vs 5.56 it/s — and the gap widens with N as the
+            # materialized one-hot's HBM floor grows.  auto therefore
+            # picks it whenever the wave engine will actually run it:
+            # TPU, f32 accumulation (the kernels are single-dtype), the
+            # dense store, a learner whose engine is the wave schedule
+            # (serial/data; voting+feature run the exact engine), and a
+            # shape whose VMEM-resident histogram block fits the kernels'
+            # 100 MB budget (the A/B covered 28 cols x 63 bins; a
+            # Bosch-wide 968 x 256-pad block would NOT compile — those
+            # shapes keep the HBM-streaming onehot engine).
+            wave_capable = (
+                str(config.tpu_growth) in ("auto", "wave")
+                and not config.tpu_use_dp
+                and not config.tpu_sparse
+                and str(config.tree_learner) in ("serial", "data",
+                                                 "data_parallel"))
+            # width only resolved (and validated) when the wave engine
+            # will actually run — off-TPU growth resolves to exact here
+            # and a garbage tpu_wave_width must keep training (ADVICE r2)
+            vmem_hist_bytes = (ncols * _bin_pad(nbins) * 3 * 4
+                               * resolve_wave_width(config, self.num_leaves)
+                               if on_tpu and wave_capable else 0)
+            if on_tpu and wave_capable and vmem_hist_bytes <= 64 << 20:
+                hist_mode = "pallas_t"
+            else:
+                hist_mode = "onehot" if on_tpu else "scatter"
+        self.hist_mode = hist_mode
         self.cache_hists = hist_cache_enabled(
             config, self.num_leaves, ncols, nbins,
             8 if config.tpu_use_dp else 4)
@@ -196,15 +225,14 @@ class SerialTreeLearner:
             Log.fatal("Unknown tpu_growth %s (expected auto/exact/wave)",
                       growth)
         if growth == "auto":
-            # 'pallas' is the exact engine's per-leaf kernel; 'pallas_t'
-            # 'pallas_f' and 'pallas_ft' exist only as wave kernels
-            if hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
+            # 'pallas' is the exact engine's per-leaf kernel; the
+            # WAVE_ONLY_MODES kernels exist only as wave kernels
+            if hist_mode in WAVE_ONLY_MODES:
                 growth = "wave"
             else:
                 growth = ("wave" if jax.default_backend() == "tpu"
                           and hist_mode != "pallas" else "exact")
-        if growth == "exact" and hist_mode in ("pallas_t", "pallas_f",
-                                               "pallas_ft"):
+        if growth == "exact" and hist_mode in WAVE_ONLY_MODES:
             Log.fatal("tpu_histogram_mode=%s requires tpu_growth=wave "
                       "(this kernel is wave-only)" % hist_mode)
         # ---- sparse device store (SparseBin/OrderedSparseBin analog,
@@ -369,8 +397,7 @@ class SerialTreeLearner:
         # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (
             default_row_capacities(train_data.num_data + self._row_pad)
-            if hist_mode not in ("pallas", "pallas_t", "pallas_f",
-                                 "pallas_ft", "sparse")
+            if hist_mode not in ("pallas", "sparse") + WAVE_ONLY_MODES
             else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice
@@ -426,9 +453,7 @@ class SerialTreeLearner:
             # wave-only pallas_t kernel maps to onehot here — mesh
             # subclasses that run the wave schedule install their own
             # pallas_t-capable grow right after this constructor
-            base_mode = ("onehot"
-                         if hist_mode in ("pallas_t", "pallas_f",
-                                          "pallas_ft")
+            base_mode = ("onehot" if hist_mode in WAVE_ONLY_MODES
                          else hist_mode)
             self._grow = make_grow_fn(self.num_leaves, self.num_bins,
                                       self.meta, self.params,

@@ -38,6 +38,7 @@ except ImportError:  # older jax
 from ..io.dataset import TrainingData
 from ..ops.grow import make_grow_fn
 from ..ops.learner import SerialTreeLearner, build_split_params
+from ..ops.pallas_wave import WAVE_ONLY_MODES
 from ..ops.split_finder import FeatureMeta
 from ..utils.config import Config
 from ..utils.log import Log
@@ -205,7 +206,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 chunk=int(config.tpu_wave_chunk),
                 sparse_col_cap=self.sparse_col_cap)
         else:
-            if self.hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
+            if self.hist_mode in WAVE_ONLY_MODES:
                 Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                           "voting-parallel learner's exact engine does not "
                           "support it" % self.hist_mode)
@@ -339,7 +340,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             is_categorical=jnp.concatenate(
                 [jnp.asarray(train_data.is_categorical_arr, bool),
                  jnp.zeros(fpad, bool)]))
-        if self.hist_mode in ("pallas_t", "pallas_f", "pallas_ft"):
+        if self.hist_mode in WAVE_ONLY_MODES:
             Log.fatal("tpu_histogram_mode=%s is wave-only; the "
                       "feature-parallel learner's exact engine does not "
                       "support it" % self.hist_mode)

@@ -323,7 +323,10 @@ class Config:
         # exact-engine per-leaf kernel, 'pallas_t' = wave kernel with
         # MXU-native transposed operands, 'pallas_f' = fused partition+
         # histogram wave kernel, 'pallas_ft' = fused AND transposed —
-        # routing from row-major X, MXU contraction from X_t)
+        # routing from row-major X, MXU contraction from X_t).  auto =
+        # pallas_t on TPU when the wave engine runs it (f32, dense,
+        # serial/data learner; measured fastest on v5e), else onehot on
+        # TPU, scatter elsewhere.
         "tpu_histogram_mode": ("str", "auto"),
         # 'auto' | 'exact' | 'wave' — growth schedule (ops/wave.py):
         # 'exact' is the reference's one-split-at-a-time leaf-wise order;

@@ -4,6 +4,8 @@ The key invariant: with max_conflict_rate=0 the bundled representation is
 lossless, so training with EFB on must produce EXACTLY the trees of
 training with enable_bundle=false.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -146,3 +148,18 @@ def test_max_conflict_rate_budget():
     td1 = TrainingData.from_matrix(X, label=y, config=Config(
         {"verbose": -1, "max_conflict_rate": 0.5, "max_bin": 63}))
     assert td1.bundle is not None and td1.bundle.num_groups == 1
+
+
+def test_binary_dataset_arbitrary_extension(tmp_path):
+    """save_binary must write EXACTLY the requested filename — numpy's
+    savez appends '.npz' to alien extensions, which broke the reference's
+    save-to-any-name contract (dataset.cpp:489 writes e.g. 'train.bin')."""
+    X, y = _onehot_data(seed=8)
+    fn = str(tmp_path / "train.bin")
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    ds.save_binary(fn)
+    assert os.path.exists(fn) and not os.path.exists(fn + ".npz")
+    ds2 = lgb.Dataset(fn)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, ds2, num_boost_round=3)
+    assert bst.predict(X).shape == (len(y),)

@@ -179,3 +179,47 @@ def test_fused_kernel_packed(layout):
     np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
     np.testing.assert_allclose(np.asarray(got_hist), want_hist,
                                rtol=5e-4, atol=5e-4)
+
+
+def test_auto_hist_mode_resolution(monkeypatch):
+    """tpu_histogram_mode=auto picks the measured winner per backend:
+    pallas_t on TPU when the wave engine will run it; onehot on TPU
+    otherwise; scatter on CPU (tools/AB_RESULTS.md)."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops.learner import SerialTreeLearner
+    from lightgbm_tpu.io.dataset import TrainingData
+    from lightgbm_tpu.utils.config import Config
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    def learner_for(**over):
+        cfg = Config(dict({"objective": "binary", "num_leaves": 7,
+                           "verbose": -1}, **over))
+        td = TrainingData.from_matrix(X, label=y, config=cfg)
+        return SerialTreeLearner(cfg, td)
+
+    # CPU truth (this process): scatter
+    assert learner_for().hist_mode == "scatter"
+
+    # fake the TPU backend: resolution must flip to pallas_t / onehot
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert learner_for().hist_mode == "pallas_t"
+    assert learner_for(tpu_growth="exact").hist_mode == "onehot"
+    assert learner_for(tpu_use_dp=True).hist_mode == "onehot"
+    sp = learner_for(tpu_sparse=True)
+    assert sp.hist_mode == "sparse"    # sparse store keeps its own path
+    assert learner_for(tree_learner="voting").hist_mode == "onehot"
+
+    # VMEM feasibility: a wide/high-bin shape whose in-VMEM histogram
+    # block (ncols * bin_pad * 3W * 4B) exceeds the kernels' budget must
+    # keep the HBM-streaming onehot engine (800 cols * 256-pad * 3 * 64
+    # * 4B ~= 157 MB > 64 MB; 700 rows bin to >128 levels -> pad 256)
+    Xw = rng.normal(size=(700, 800))
+    yw = (Xw[:, 0] > 0).astype(np.float64)
+    cfg = Config({"objective": "binary", "num_leaves": 255,
+                  "max_bin": 255, "tpu_wave_width": 64, "verbose": -1})
+    tdw = TrainingData.from_matrix(Xw, label=yw, config=cfg)
+    assert SerialTreeLearner(cfg, tdw).hist_mode == "onehot"

@@ -22,7 +22,9 @@ def make_data(n_rows, n_features=28):
 
 
 def run(X, y, mode, wave_width=32, warmup=3, measured=10,
-        extra=None):
+        extra=None, train_set=None):
+    """Time one engine config; X/y are ignored when a prebuilt train_set
+    (e.g. loaded from a .bin dataset cache) is passed instead."""
     import jax
     import lightgbm_tpu as lgb
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
@@ -30,7 +32,10 @@ def run(X, y, mode, wave_width=32, warmup=3, measured=10,
               "metric": "auc", "tpu_growth": "wave",
               "tpu_wave_width": wave_width, "tpu_histogram_mode": mode}
     params.update(extra or {})
-    train_set = lgb.Dataset(X, label=y, params=params)
+    if train_set is None:
+        train_set = lgb.Dataset(X, label=y, params=params)
+    else:
+        train_set.params = dict(train_set.params or {}, **params)
     bst = lgb.Booster(params=params, train_set=train_set)
     gbdt = bst._gbdt
     for _ in range(warmup):

@@ -40,16 +40,30 @@ def child(spec):
         dt, auc = run(X, y, spec["mode"], wave_width=spec["width"],
                       extra=spec.get("extra"))
     else:  # bosch-shaped sparse
-        rng = np.random.default_rng(7)
-        ns, fs = spec["n"], 968
-        nnz = int(ns * fs * 0.01)
-        X = np.zeros((ns, fs), np.float32)
-        X[rng.integers(0, ns, nnz), rng.integers(0, fs, nnz)] = \
-            rng.normal(size=nnz)
-        y = (X[:, 0] + X[:, 1] > 0.02).astype(np.float64)
-        dt, auc = run(X, y, spec.get("mode", "auto"),
+        # data gen + 968-column binning is minutes of one-core host work;
+        # cache the BINNED dataset so a wedge retry pays it only once
+        import lightgbm_tpu as lgb
+        cache = "/tmp/ab2_bosch_%d.bin" % spec["n"]
+        if os.path.exists(cache):
+            ds = lgb.Dataset(cache)
+        else:
+            rng = np.random.default_rng(7)
+            ns, fs = spec["n"], 968
+            nnz = int(ns * fs * 0.01)
+            X = np.zeros((ns, fs), np.float32)
+            X[rng.integers(0, ns, nnz), rng.integers(0, fs, nnz)] = \
+                rng.normal(size=nnz)
+            y = (X[:, 0] + X[:, 1] > 0.02).astype(np.float64)
+            ds = lgb.Dataset(X, label=y,
+                             params={"max_bin": 63, "verbose": -1})
+            ds.construct()
+            # atomic publish: a timeout kill mid-write must not leave a
+            # truncated cache that every retry then crashes on
+            ds.save_binary(cache + ".tmp")
+            os.replace(cache + ".tmp", cache)
+        dt, auc = run(None, None, spec.get("mode", "auto"),
                       wave_width=spec["width"], measured=5,
-                      extra=spec.get("extra"))
+                      extra=spec.get("extra"), train_set=ds)
     print(json.dumps({"dt": dt, "auc": auc, "wall": time.time() - t0}),
           flush=True)
 
@@ -126,13 +140,13 @@ def main():
         ("engine pallas_f W=64",
          {"kind": "dense", "n": n, "mode": "pallas_f", "width": 64}),
         ("bosch1Mx968 sparse exact",
-         {"kind": "sparse", "n": 1_000_000, "width": 1,
+         {"kind": "sparse", "n": 1_000_000, "width": 1, "timeout": 2700,
           "extra": {"tpu_sparse": True, "tpu_growth": "exact"}}),
         ("bosch1Mx968 sparse wave8",
-         {"kind": "sparse", "n": 1_000_000, "width": 8,
+         {"kind": "sparse", "n": 1_000_000, "width": 8, "timeout": 2700,
           "extra": {"tpu_sparse": True, "tpu_growth": "wave"}}),
         ("bosch1Mx968 dense  exact",
-         {"kind": "sparse", "n": 1_000_000, "width": 1,
+         {"kind": "sparse", "n": 1_000_000, "width": 1, "timeout": 2700,
           "extra": {"tpu_growth": "exact"}}),
     ]
     run_combos(combos, n)
@@ -164,7 +178,8 @@ def run_combos(combos, n):
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), "--child",
                      json.dumps(spec)],
-                    capture_output=True, text=True, timeout=COMBO_TIMEOUT,
+                    capture_output=True, text=True,
+                    timeout=spec.get("timeout", COMBO_TIMEOUT),
                     cwd=REPO, env=env)
                 if r.returncode != 0:
                     raise RuntimeError(_last_error_line(r.stderr, name,
@@ -178,7 +193,8 @@ def run_combos(combos, n):
                 fail_counts[name] += 1
                 if fail_counts[name] >= 2:
                     append("    %-26s: TIMEOUT x%d after %ds each — giving up"
-                           % (name, fail_counts[name], COMBO_TIMEOUT))
+                           % (name, fail_counts[name],
+                              spec.get("timeout", COMBO_TIMEOUT)))
                 else:
                     print("  %s timed out (attempt %d); re-queued"
                           % (name, fail_counts[name]), flush=True)
