@@ -161,7 +161,7 @@ class SerialTreeLearner:
                 is_categorical=jnp.asarray(train_data.is_categorical_arr),
             )
         self.params = build_split_params(config)
-        from .pallas_wave import WAVE_ONLY_MODES, _bin_pad
+        from .wave import WAVE_ONLY_MODES, _bin_pad
         hist_mode = config.tpu_histogram_mode
         if hist_mode not in (("auto", "onehot", "scatter", "pallas")
                              + WAVE_ONLY_MODES):
@@ -189,10 +189,12 @@ class SerialTreeLearner:
             # TPU, f32 accumulation (the kernels are single-dtype), the
             # dense store, a learner whose engine is the wave schedule
             # (serial/data; voting+feature run the exact engine), and a
-            # shape whose VMEM-resident histogram block fits the kernels'
-            # 100 MB budget (the A/B covered 28 cols x 63 bins; a
-            # Bosch-wide 968 x 256-pad block would NOT compile — those
-            # shapes keep the HBM-streaming onehot engine).
+            # shape whose VMEM-resident histogram block leaves headroom
+            # inside the kernels' 100 MB compiler budget — the gate uses
+            # 64 MB so input tiles/temporaries fit too (the A/B covered
+            # 28 cols x 63 bins; a Bosch-wide 968 x 256-pad block would
+            # NOT compile — those shapes keep the HBM-streaming onehot
+            # engine).
             wave_capable = (
                 str(config.tpu_growth) in ("auto", "wave")
                 and not config.tpu_use_dp
@@ -412,13 +414,14 @@ class SerialTreeLearner:
                 self.sparse_col_cap)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
-            # booster (X never changes across trees), not per dispatch
-            # mirror make_wave_core's use_pallas_hist gate (TPU + f32) so
-            # no dead (F, N) copy is pinned when the kernel won't run
+            # booster (X never changes across trees), not per dispatch;
+            # the shared predicate keeps this in lockstep with the engine
+            # gate so no dead (F, N) copy is pinned when the kernel won't
+            # run
+            from .wave import transposed_wave_active
             xt = (jnp.transpose(self.X)
-                  if hist_mode in ("pallas_t", "pallas_ft")
-                  and jax.default_backend() == "tpu"
-                  and self.dtype == jnp.float32 else None)
+                  if transposed_wave_active(hist_mode, self.dtype)
+                  else None)
 
             def _grow(X, g, h, rm, m, _core=core, _meta=meta,
                       _bund=bund, _xt=xt):

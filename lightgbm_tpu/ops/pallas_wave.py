@@ -35,16 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-# modes implemented only as wave-schedule kernels; every engine/learner
-# gate imports THIS tuple so adding a kernel variant is a one-line change
-WAVE_ONLY_MODES = ("pallas_t", "pallas_f", "pallas_ft")
-
-
-def _bin_pad(num_bins: int) -> int:
-    """Padded per-feature bin width so F*Bp stays lane-friendly."""
-    if num_bins <= 64:
-        return 64
-    return ((num_bins + 127) // 128) * 128
+from .wave import WAVE_ONLY_MODES, _bin_pad  # noqa: F401  (shared policy
+# lives in wave.py, which stays importable without jax.experimental.pallas)
 
 
 def _tile_plan(n, fc, bp, row_tile):

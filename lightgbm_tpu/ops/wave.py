@@ -48,6 +48,38 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            RIGHT_SUM_H, SPLIT_VEC_SIZE, THRESHOLD,
                            FeatureMeta, SplitParams, find_best_split_impl)
 
+# modes implemented only as wave-schedule Pallas kernels; every
+# engine/learner gate imports THIS tuple so adding a kernel variant is a
+# one-line change.  Lives here (not pallas_wave.py) so CPU-only installs
+# never import jax.experimental.pallas just to validate a config.
+WAVE_ONLY_MODES = ("pallas_t", "pallas_f", "pallas_ft")
+
+
+def _bin_pad(num_bins: int) -> int:
+    """Padded per-feature bin width so F*Bp stays lane-friendly (shared
+    policy of the Pallas wave kernels and the auto-mode VMEM gate)."""
+    if num_bins <= 64:
+        return 64
+    return ((num_bins + 127) // 128) * 128
+
+
+def pallas_wave_active(hist_mode: str, hist_dtype=jnp.float32) -> bool:
+    """True when a Pallas wave kernel will ACTUALLY run: TPU backend, f32
+    accumulation (the kernels are single-dtype), and a pallas mode.  The
+    single copy of this predicate — the engine gate, the serial learner's
+    Xt precompute, and the mesh learner's Xt precompute all import it."""
+    return (jax.default_backend() == "tpu"
+            and hist_dtype == jnp.float32
+            and hist_mode in ("pallas",) + WAVE_ONLY_MODES)
+
+
+def transposed_wave_active(hist_mode: str, hist_dtype=jnp.float32) -> bool:
+    """True when the running kernel is one of the TRANSPOSED layouts —
+    i.e. a per-booster (F, N) Xt is worth materializing."""
+    return (hist_mode in ("pallas_t", "pallas_ft")
+            and pallas_wave_active(hist_mode, hist_dtype))
+
+
 
 def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       params: SplitParams, max_depth: int,
@@ -55,17 +87,29 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       psum_axis: str = None, bundle=None,
                       group_bins: int = 0, cache_hists: bool = True,
                       hist_mode: str = "onehot", chunk: int = 16384,
-                      packed_cols: int = 0, sparse_col_cap: int = 0):
+                      packed_cols: int = 0, sparse_col_cap: int = 0,
+                      with_xt: bool = False):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
-    (TreeArrays, leaf_id))."""
+    (TreeArrays, leaf_id)).
+
+    with_xt=True: the returned grow takes a SIXTH positional arg — the
+    precomputed transposed bin matrix for the transposed Pallas kernels —
+    so shard_map callers can pass a per-booster Xt instead of paying one
+    (F, N) materialization per tree dispatch (the serial learner's
+    keyword path, learner.py)."""
     core = make_wave_core(num_leaves, num_bins, params, max_depth,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
                           hist_mode, chunk, packed_cols, sparse_col_cap)
 
-    def grow(X, grad, hess, row_mult, feature_mask):
-        return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
+    if with_xt:
+        def grow(X, grad, hess, row_mult, feature_mask, Xt):
+            return core(X, grad, hess, row_mult, feature_mask, meta,
+                        bundle, Xt=Xt)
+    else:
+        def grow(X, grad, hess, row_mult, feature_mask):
+            return core(X, grad, hess, row_mult, feature_mask, meta, bundle)
 
     grow.core = core
     return grow
@@ -114,10 +158,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # Opt-in (hist_mode='pallas' row-major / 'pallas_t' transposed) while
     # their end-to-end win is validated; precision is handled by the bf16
     # hi/lo weight split (manual rounding — Mosaic's cast truncates).
-    use_pallas_hist = (jax.default_backend() == "tpu"
-                       and hist_dtype == jnp.float32
-                       and hist_mode in ("pallas", "pallas_t", "pallas_f",
-                                         "pallas_ft"))
+    use_pallas_hist = pallas_wave_active(hist_mode, hist_dtype)
     # 'pallas_ft' routes from row-major X and contracts from X_t — it is
     # both transposed (needs Xt, rehists via the v2 kernel) and fused
     pallas_transposed = hist_mode in ("pallas_t", "pallas_ft")

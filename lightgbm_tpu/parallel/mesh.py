@@ -38,7 +38,7 @@ except ImportError:  # older jax
 from ..io.dataset import TrainingData
 from ..ops.grow import make_grow_fn
 from ..ops.learner import SerialTreeLearner, build_split_params
-from ..ops.pallas_wave import WAVE_ONLY_MODES
+from ..ops.wave import WAVE_ONLY_MODES
 from ..ops.split_finder import FeatureMeta
 from ..utils.config import Config
 from ..utils.log import Log
@@ -193,10 +193,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
         caps = (default_row_capacities(local_rows)
                 if self.row_capacities else ())   # same gate, per-shard rows
         voting = bool(self._grow_kwargs(n_shards).get("voting_k", 0))
+        self._Xt = None
         if self.growth == "wave" and not voting:
             # wave schedule under the data mesh: the per-wave histogram
             # block is psum'd ONCE (W splits per collective instead of one)
             from ..ops.wave import make_wave_grow_fn
+            # transposed Pallas kernels: materialize Xt ONCE per booster
+            # (a row-shard of X and the matching column-shard of Xt live
+            # on the same device, so this transpose is comm-free) instead
+            # of once per tree dispatch inside the shard-mapped grow
+            from ..ops.wave import transposed_wave_active
+            needs_xt = (transposed_wave_active(self.hist_mode, self.dtype)
+                        and not self.sparse_on)
             grow = make_wave_grow_fn(
                 self.num_leaves, self.num_bins, self.meta, self.params,
                 config.max_depth, wave_width=self.wave_width,
@@ -204,7 +212,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 bundle=self.bundle_arrays, group_bins=self.group_bins,
                 cache_hists=self.cache_hists, hist_mode=self.hist_mode,
                 chunk=int(config.tpu_wave_chunk),
-                sparse_col_cap=self.sparse_col_cap)
+                sparse_col_cap=self.sparse_col_cap, with_xt=needs_xt)
+            if needs_xt:
+                self._Xt = jax.jit(
+                    jnp.transpose,
+                    out_shardings=NamedSharding(self.mesh,
+                                                P(None, DATA_AXIS)))(self.X)
         else:
             if self.hist_mode in WAVE_ONLY_MODES:
                 Log.fatal("tpu_histogram_mode=%s is wave-only; the "
@@ -226,10 +239,13 @@ class DataParallelTreeLearner(SerialTreeLearner):
             x_spec = SparseDeviceStore(*([P(DATA_AXIS)] * 5))
         else:
             x_spec = P(DATA_AXIS, None)
+        in_specs = (x_spec, P(DATA_AXIS), P(DATA_AXIS),
+                    P(DATA_AXIS), P())
+        if self._Xt is not None:
+            in_specs += (P(None, DATA_AXIS),)
         sharded_grow = _shard_map_compat(
             grow, mesh=self.mesh,
-            in_specs=(x_spec, P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS), P()),
+            in_specs=in_specs,
             out_specs=(jax.tree_util.tree_map(lambda _: P(),
                                               self._dummy_tree_spec()),
                        P(DATA_AXIS)))
@@ -277,7 +293,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
             row_mult = self._pad_rows_dev(row_mult)
         if feature_mask is None:
             feature_mask = self.sample_feature_mask()
-        tree, leaf_id = self._grow(self.X, grad, hess, row_mult, feature_mask)
+        args = (self.X, grad, hess, row_mult, feature_mask)
+        if self._Xt is not None:
+            args += (self._Xt,)
+        tree, leaf_id = self._grow(*args)
         if self._nproc > 1:
             return tree, leaf_id     # global, matches global score arrays
         return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id

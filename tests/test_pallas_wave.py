@@ -204,7 +204,12 @@ def test_auto_hist_mode_resolution(monkeypatch):
     # CPU truth (this process): scatter
     assert learner_for().hist_mode == "scatter"
 
-    # fake the TPU backend: resolution must flip to pallas_t / onehot
+    # fake the TPU backend: resolution must flip to pallas_t / onehot.
+    # Clear the wave-core caches before AND after — cores built under the
+    # fake bake use_pallas_hist=True into lru_cache entries whose static
+    # keys later CPU tests could hit (dispatching real Pallas on CPU).
+    from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert learner_for().hist_mode == "pallas_t"
     assert learner_for(tpu_growth="exact").hist_mode == "onehot"
@@ -223,3 +228,79 @@ def test_auto_hist_mode_resolution(monkeypatch):
                   "max_bin": 255, "tpu_wave_width": 64, "verbose": -1})
     tdw = TrainingData.from_matrix(Xw, label=yw, config=cfg)
     assert SerialTreeLearner(cfg, tdw).hist_mode == "onehot"
+    # wipe cores built under the fake before later CPU tests can hit them
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+
+
+def test_with_xt_grow_signature_matches():
+    """make_wave_grow_fn(with_xt=True) takes Xt positionally and produces
+    the identical tree off-TPU (where the kernel is bypassed and Xt is
+    ignored) — the mesh learner's per-booster-Xt plumbing contract."""
+    from lightgbm_tpu.ops.wave import make_wave_grow_fn
+    from lightgbm_tpu.ops.learner import build_split_params
+    from lightgbm_tpu.ops.split_finder import FeatureMeta
+    from lightgbm_tpu.io.dataset import TrainingData
+    from lightgbm_tpu.utils.config import Config
+    import jax
+
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(900, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 15, "verbose": -1})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    meta = FeatureMeta(num_bin=jnp.asarray(td.num_bin_arr),
+                       default_bin=jnp.asarray(td.default_bin_arr),
+                       is_categorical=jnp.asarray(td.is_categorical_arr))
+    common = dict(wave_width=4, hist_mode="pallas_t")
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(len(y), 0.25, np.float32)
+    args = (jnp.asarray(td.binned), jnp.asarray(g), jnp.asarray(h),
+            jnp.ones(len(y), jnp.float32),
+            jnp.ones(td.num_features, dtype=bool))
+    grow0 = make_wave_grow_fn(15, int(td.num_bin_arr.max()), meta,
+                              build_split_params(cfg), -1, **common)
+    grow1 = make_wave_grow_fn(15, int(td.num_bin_arr.max()), meta,
+                              build_split_params(cfg), -1, with_xt=True,
+                              **common)
+    t0, l0 = grow0(*args)
+    t1, l1 = grow1(*args, jnp.transpose(args[0]))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    np.testing.assert_array_equal(np.asarray(t0.leaf_value),
+                                  np.asarray(t1.leaf_value))
+
+
+def test_mesh_precomputes_xt_for_transposed_kernels(monkeypatch):
+    """Under the data mesh with a transposed pallas mode on (a faked) TPU
+    backend, the learner materializes the (F, N) transposed matrix ONCE
+    per booster with a column sharding — not per tree inside the grow."""
+    import jax
+    from lightgbm_tpu.parallel.mesh import (DataParallelTreeLearner,
+                                            make_data_mesh)
+    from lightgbm_tpu.io.dataset import TrainingData
+    from lightgbm_tpu.utils.config import Config
+
+    rng = np.random.default_rng(22)
+    X = rng.normal(size=(1100, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 15, "verbose": -1, "tree_learner": "data",
+                  "tpu_histogram_mode": "pallas_t"})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    mesh = make_data_mesh(jax.devices()[:4])
+
+    from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    dp = DataParallelTreeLearner(cfg, td, mesh)
+    assert dp._Xt is not None
+    n_pad = dp.X.shape[0]
+    assert dp._Xt.shape == (td.binned.shape[1], n_pad)
+    # column-sharded: each device holds the transpose of its row shard
+    spec = dp._Xt.sharding.spec
+    assert tuple(spec) == (None, "data")
+
+    # off-TPU (real backend): no Xt is pinned.  Cache-clear first: the
+    # faked-backend cores above share static keys with real-CPU ones.
+    monkeypatch.undo()
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+    dp2 = DataParallelTreeLearner(cfg, td, mesh)
+    assert dp2._Xt is None

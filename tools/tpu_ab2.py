@@ -118,6 +118,14 @@ FOLLOWUP = [
      {"kind": "dense", "n": 0, "mode": "pallas_f", "width": 32}),
     ("engine pallas_t W=64",
      {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 64}),
+    # width scaling: each sweep pays one pass over X regardless of W, so
+    # doubling W nearly halves the sweeps per tree — quality permitting
+    ("engine pallas_t W=128",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 128}),
+    ("engine pallas_ft W=128",
+     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 128}),
+    ("engine onehot   W=32",
+     {"kind": "dense", "n": 0, "mode": "onehot", "width": 32}),
 ]
 
 
