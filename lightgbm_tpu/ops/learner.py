@@ -82,7 +82,30 @@ def build_bundle_arrays(train_data: TrainingData):
     return arrays, Bg
 
 
-def resolve_wave_width(config: Config, num_leaves: int) -> int:
+def _order_sensitive(config: Config) -> bool:
+    """Configs whose quality measurably depends on the leaf-wise split
+    ORDER (PARITY_TRAINING.md: lambdarank NDCG; DART/GOSS/InfiniteBoost
+    compound the approximation through tree re-weighting / sampling)."""
+    return (str(config.objective) in ("lambdarank", "rank")
+            or str(config.boosting_type) in ("dart", "goss", "infinite",
+                                             "infiniteboost"))
+
+
+def resolve_wave_order(config: Config) -> str:
+    """tpu_wave_order: auto -> 'exact' where order matters (those configs
+    then keep wave-width speed WITH the reference's split sequence),
+    'batched' otherwise (proven quality parity at full speed)."""
+    v = str(config.tpu_wave_order).strip().lower()
+    if v not in ("auto", "batched", "exact"):
+        Log.fatal("Unknown tpu_wave_order %s (expected auto/batched/"
+                  "exact)", v)
+    if v != "auto":
+        return v
+    return "exact" if _order_sensitive(config) else "batched"
+
+
+def resolve_wave_width(config: Config, num_leaves: int,
+                       wave_order: str = "batched") -> int:
     """tpu_wave_width=-1 -> auto: scale the wave to the frontier size,
     gated on QUALITY, not only speed.
 
@@ -90,25 +113,26 @@ def resolve_wave_width(config: Config, num_leaves: int) -> int:
     W=32 at 255 — bigger waves amortize the per-sweep pass over more
     splits, but at small trees they just pad the frontier.
 
-    Quality (PARITY_TRAINING.md): batched frontiers approximate the
+    Quality (PARITY_TRAINING.md): BATCHED frontiers approximate the
     leaf-wise split ORDER; at W=8 the measured deltas vs the reference
     are within ~1e-3 for plain-GBDT binary/multiclass metrics but
     -6.4e-3 NDCG@10 on lambdarank (ranking gains are order-sensitive)
     and +0.9e-2..+3e-2 logloss under DART/GOSS/InfiniteBoost (their
     tree re-weighting / gradient sampling compounds the order
-    approximation) — so auto resolves to W=1 (the reference's exact
-    split sequence) for those.  Explicit user values always pass
-    through.
+    approximation).  Those configs auto-resolve to tpu_wave_order=exact
+    (which reproduces the leaf-wise sequence bit-for-bit at any W,
+    tests/test_wave_exact_order.py) and KEEP the width ladder; under an
+    explicit tpu_wave_order=batched they fall back to W=1.  Explicit
+    user widths always pass through.
     """
     w = int(config.tpu_wave_width)
     if w > 0:
         return w
     if w != -1:
         Log.fatal("tpu_wave_width must be positive or -1 (auto), got %d", w)
-    if str(config.objective) in ("lambdarank", "rank"):
-        return 1
-    if str(config.boosting_type) in ("dart", "goss", "infinite",
-                                     "infiniteboost"):
+    if _order_sensitive(config) and wave_order != "exact":
+        # batched waves approximate the split order — these configs pay
+        # W=1 unless the exact-order schedule carries them
         return 1
     if num_leaves <= 31:
         return 8
@@ -205,7 +229,9 @@ class SerialTreeLearner:
             # will actually run — off-TPU growth resolves to exact here
             # and a garbage tpu_wave_width must keep training (ADVICE r2)
             vmem_hist_bytes = (ncols * _bin_pad(nbins) * 3 * 4
-                               * resolve_wave_width(config, self.num_leaves)
+                               * resolve_wave_width(
+                                   config, self.num_leaves,
+                                   resolve_wave_order(config))
                                if on_tpu and wave_capable else 0)
             if on_tpu and wave_capable and vmem_hist_bytes <= 64 << 20:
                 hist_mode = "pallas_t"
@@ -284,7 +310,10 @@ class SerialTreeLearner:
         # wave width only matters (and is only validated) under wave
         # growth — an exact-growth config with a leftover garbage
         # tpu_wave_width must keep training (ADVICE r2).
-        self.wave_width = (resolve_wave_width(config, self.num_leaves)
+        self.wave_order = (resolve_wave_order(config)
+                           if growth == "wave" else "batched")
+        self.wave_width = (resolve_wave_width(config, self.num_leaves,
+                                              self.wave_order)
                            if growth == "wave" else 1)
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
         # every device column fits a nibble, store TWO columns per byte in
@@ -411,7 +440,7 @@ class SerialTreeLearner:
                 self.bundle_arrays is not None, self.group_bins,
                 self.cache_hists, hist_mode,
                 int(config.tpu_wave_chunk), self.packed_cols,
-                self.sparse_col_cap)
+                self.sparse_col_cap, self.wave_order == "exact")
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;

@@ -88,7 +88,7 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       group_bins: int = 0, cache_hists: bool = True,
                       hist_mode: str = "onehot", chunk: int = 16384,
                       packed_cols: int = 0, sparse_col_cap: int = 0,
-                      with_xt: bool = False):
+                      with_xt: bool = False, exact_order: bool = False):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id)).
@@ -101,7 +101,8 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
     core = make_wave_core(num_leaves, num_bins, params, max_depth,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
-                          hist_mode, chunk, packed_cols, sparse_col_cap)
+                          hist_mode, chunk, packed_cols, sparse_col_cap,
+                          exact_order)
 
     if with_xt:
         def grow(X, grad, hess, row_mult, feature_mask, Xt):
@@ -128,7 +129,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    max_depth: int, wave_width: int, hist_dtype,
                    psum_axis: str, has_bundle: bool, group_bins: int,
                    cache_hists: bool, hist_mode: str, chunk: int,
-                   packed_cols: int = 0, sparse_col_cap: int = 0):
+                   packed_cols: int = 0, sparse_col_cap: int = 0,
+                   exact_order: bool = False):
     """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
     byte) and packed_cols is the LOGICAL column count; every chunk is
     unpacked in-scan so the full-width matrix never hits HBM (the
@@ -330,13 +332,28 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                     # child-masked weights: (C, W) match x (C, 3) channels
                     match = ((lc2[:, None] == small_id[None, :])
                              & valid[None, :]).astype(hist_dtype)
-                    wmat = (match[:, :, None]
-                            * wc[:, None, :]).reshape(c, 3 * W)
                     oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
                                         dtype=oh_dtype)      # (C, Fc, B)
-                    acc = acc + jnp.einsum(
-                        "cq,cw->qw", oh.reshape(c, Fc * hist_bins), wmat,
-                        preferred_element_type=hist_dtype)
+                    ohf = oh.reshape(c, Fc * hist_bins)
+                    if exact_order:
+                        # per-candidate GEMMs of exactly tpu_wave_width=1's
+                        # operand shape: XLA's reduction order varies with
+                        # the (C, 3W) width, so ONE wide contraction would
+                        # drift from the W=1 baseline by ulps — per-slot
+                        # contraction keeps exact-order trees bit-equal to
+                        # the pinned leaf-wise order
+                        parts = [jnp.einsum(
+                            "cq,cw->qw", ohf,
+                            match[:, w:w + 1] * wc,
+                            preferred_element_type=hist_dtype)
+                            for w in range(W)]
+                        acc = acc + jnp.concatenate(parts, axis=1)
+                    else:
+                        wmat = (match[:, :, None]
+                                * wc[:, None, :]).reshape(c, 3 * W)
+                        acc = acc + jnp.einsum(
+                            "cq,cw->qw", ohf, wmat,
+                            preferred_element_type=hist_dtype)
                 return acc, lc2
 
             acc_shape = ((Fc * hist_bins, 3 * W) if not use_pallas_hist
@@ -372,12 +389,22 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 xc = unpack(xc)
                 match = ((lc[:, None] == ids[None, :])
                          & valid[None, :]).astype(hist_dtype)
-                wmat = (match[:, :, None] * wc[:, None, :]).reshape(c, 3 * W)
                 oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
                                     dtype=oh_dtype)
-                acc = acc + jnp.einsum(
-                    "cq,cw->qw", oh.reshape(c, Fc * hist_bins), wmat,
-                    preferred_element_type=hist_dtype)
+                ohf = oh.reshape(c, Fc * hist_bins)
+                if exact_order:
+                    # W=1-shaped per-candidate GEMMs (see wave_pass)
+                    parts = [jnp.einsum(
+                        "cq,cw->qw", ohf, match[:, w:w + 1] * wc,
+                        preferred_element_type=hist_dtype)
+                        for w in range(W)]
+                    acc = acc + jnp.concatenate(parts, axis=1)
+                else:
+                    wmat = (match[:, :, None]
+                            * wc[:, None, :]).reshape(c, 3 * W)
+                    acc = acc + jnp.einsum(
+                        "cq,cw->qw", ohf, wmat,
+                        preferred_element_type=hist_dtype)
                 return acc, None
 
             init = jnp.zeros((Fc * hist_bins, 3 * W), dtype=hist_dtype)
@@ -507,10 +534,6 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             hist_small = maybe_psum(hist_small)             # (W, F, B, 3)
             if cache_hists:
                 hist_large = hists[parent] - hist_small
-                hsrc = jnp.where(valid, small_id, L)
-                hists = hists.at[hsrc].set(hist_small, mode="drop")
-                lsrc = jnp.where(valid, large_id, L)
-                hists = hists.at[lsrc].set(hist_large, mode="drop")
             else:
                 hist_large = maybe_psum(
                     sparse_child_hists(leaf_id, large_id, valid)
@@ -533,31 +556,86 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             depths_k = jnp.concatenate([depth, depth])
             bests_k = best_of_many(hists_k, sums_k, depths_k, feature_mask,
                                    meta, bundle)            # (2W, V)
-            ssrc = jnp.where(valid, small_id, L)
-            lsrc2 = jnp.where(valid, large_id, L)
+
+            if exact_order:
+                # ---- EXACT leaf-wise order: the candidates were ranked
+                # by pre-wave gain, so leaf-wise would commit them in rank
+                # order UNTIL a child created earlier in the wave outranks
+                # the next candidate (the reference would split that child
+                # next, serial_tree_learner.cpp:203).  Commit exactly that
+                # prefix; roll the rest back below.  Histograms are
+                # reduction-order-identical across wave widths, so trees
+                # match tpu_wave_width=1 (the pinned leaf-wise order)
+                # bit-for-bit (the per-candidate contractions below
+                # keep reductions W=1-shaped) — tests/test_wave_exact_order.py.
+                sg, lg = bests_k[:W, GAIN], bests_k[W:, GAIN]
+                cg = jnp.maximum(sg, lg)
+                cg = jnp.where(valid, cg, -jnp.inf)
+                # leaf id attaining each candidate's child max (ties ->
+                # smaller id, matching top_k's first-occurrence pick)
+                cid = jnp.where(
+                    (sg > lg) | ((sg == lg) & (small_id <= large_id)),
+                    small_id, large_id)
+                # running (max gain, smallest id attaining it) over the
+                # committed prefix — W=1's top_k breaks exact gain ties
+                # by LOWEST LEAF ID, so the stop rule must too
+                def pairmax(a, b):
+                    ga, ia = a
+                    gb, ib = b
+                    take_a = (ga > gb) | ((ga == gb) & (ia <= ib))
+                    return (jnp.where(take_a, ga, gb),
+                            jnp.where(take_a, ia, ib))
+                run, rid = lax.associative_scan(pairmax, (cg, cid))
+                mx = jnp.concatenate([jnp.full((1,), -jnp.inf, cg.dtype),
+                                      run[:-1]])              # before t
+                mid = jnp.concatenate([jnp.zeros((1,), cid.dtype),
+                                       rid[:-1]])
+                stop = (mx > gw) | ((mx == gw) & (mid < parent))  # (W,)
+                t_idx = jnp.where(jnp.any(stop),
+                                  jnp.argmax(stop).astype(jnp.int32),
+                                  jnp.asarray(W, jnp.int32))
+                kc = jnp.minimum(t_idx, k)
+                commit = rank < kc
+                # rollback: rows provisionally routed to an uncommitted
+                # right child return to the parent — ONE (L,)-table gather
+                # over leaf ids, no pass over X
+                undo = valid & ~commit
+                remap = jnp.arange(L, dtype=jnp.int32).at[
+                    jnp.where(undo, newleaf, L)].set(parent, mode="drop")
+                leaf_id = jnp.take(remap, leaf_id)
+            else:
+                commit, kc = valid, k
+
+            if cache_hists:
+                hsrc = jnp.where(commit, small_id, L)
+                hists = hists.at[hsrc].set(hist_small, mode="drop")
+                lsrc = jnp.where(commit, large_id, L)
+                hists = hists.at[lsrc].set(hist_large, mode="drop")
+            ssrc = jnp.where(commit, small_id, L)
+            lsrc2 = jnp.where(commit, large_id, L)
             bests = bests.at[ssrc].set(bests_k[:W], mode="drop")
             bests = bests.at[lsrc2].set(bests_k[W:], mode="drop")
             sums = sums.at[ssrc].set(small_sums, mode="drop")
             sums = sums.at[lsrc2].set(large_sums, mode="drop")
 
             # ---- tree bookkeeping, vectorized over the wave
-            nsrc = jnp.where(valid, node, L - 1 + 64)       # drop sentinel
+            nsrc = jnp.where(commit, node, L - 1 + 64)      # drop sentinel
             tparent = tree.leaf_parent[parent]              # (W,)
             # grandparent child-pointer fix: each split's (parent node,
             # side) slot is unique, so the W scatters cannot collide
             gp = jnp.maximum(tparent, 0)
             was_left = tree.left_child[gp] == ~parent
-            fix = valid & (tparent >= 0)
+            fix = commit & (tparent >= 0)
             lc = tree.left_child.at[jnp.where(fix & was_left, gp, L + 63)
                                     ].set(node, mode="drop")
             rc = tree.right_child.at[jnp.where(fix & ~was_left, gp, L + 63)
                                      ].set(node, mode="drop")
             lc = lc.at[nsrc].set(~parent, mode="drop")
             rc = rc.at[nsrc].set(~newleaf, mode="drop")
-            lsrc3 = jnp.where(valid, parent, L)
-            rsrc3 = jnp.where(valid, newleaf, L)
+            lsrc3 = jnp.where(commit, parent, L)
+            rsrc3 = jnp.where(commit, newleaf, L)
             tree = tree._replace(
-                num_leaves=tree.num_leaves + k,
+                num_leaves=tree.num_leaves + kc,
                 split_feature=tree.split_feature.at[nsrc].set(
                     f_w, mode="drop"),
                 threshold_bin=tree.threshold_bin.at[nsrc].set(
@@ -590,7 +668,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 leaf_depth=tree.leaf_depth.at[lsrc3].set(
                     depth, mode="drop").at[rsrc3].set(depth, mode="drop"),
             )
-            return (nn + k, k == 0, leaf_id, hists, bests, sums, tree)
+            return (nn + kc, kc == 0, leaf_id, hists, bests, sums, tree)
 
         carry = (jnp.asarray(0, jnp.int32), jnp.asarray(False), leaf_id,
                  hists, bests, sums, tree)

@@ -212,7 +212,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 bundle=self.bundle_arrays, group_bins=self.group_bins,
                 cache_hists=self.cache_hists, hist_mode=self.hist_mode,
                 chunk=int(config.tpu_wave_chunk),
-                sparse_col_cap=self.sparse_col_cap, with_xt=needs_xt)
+                sparse_col_cap=self.sparse_col_cap, with_xt=needs_xt,
+                exact_order=self.wave_order == "exact")
             if needs_xt:
                 self._Xt = jax.jit(
                     jnp.transpose,

@@ -132,7 +132,7 @@ PARAMETER_SET = {
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
-    "tpu_sparse",
+    "tpu_sparse", "tpu_wave_order",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -339,6 +339,16 @@ class Config:
         # on v5e: W=16 fastest at 63 leaves, W=32 at 255); set 1 to
         # reproduce the reference's exact split sequence.
         "tpu_wave_width": ("int", -1),
+        # 'auto' | 'batched' | 'exact' — wave COMMIT ORDER.  'batched'
+        # commits all W top-gain splits per sweep (fastest; the greedy
+        # frontier approximates the leaf-wise ORDER).  'exact' computes
+        # the same W candidate histograms per sweep but commits only the
+        # prefix the reference's leaf-wise order would have produced
+        # (rolling the rest back with a leaf-id remap) — trees match
+        # tpu_wave_width=1 bit-for-bit at wave-level HBM economics.
+        # auto -> exact for order-sensitive configs (lambdarank, DART,
+        # GOSS, InfiniteBoost), batched otherwise.
+        "tpu_wave_order": ("str", "auto"),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
         # (VMEM-residency vs scan-overhead tradeoff on TPU; engine

@@ -64,10 +64,17 @@ NOTES = {
                   "top-W splits per sweep on the MXU)",
     "tpu_wave_width": "W in wave growth; -1 = auto by num_leaves; 1 = the "
                       "reference's exact split order",
+    "tpu_wave_order": "auto / batched / exact — wave commit order; exact "
+                      "reproduces the leaf-wise split sequence bit-for-bit "
+                      "at any W (auto: exact for lambdarank/DART/GOSS/"
+                      "InfiniteBoost, batched otherwise)",
     "tpu_wave_chunk": "row-chunk of the wave sweep (VMEM vs scan-overhead "
                       "tradeoff; minimum 256, smaller values clamp)",
     "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t / "
-                          "pallas_f histogram kernels",
+                          "pallas_f / pallas_ft histogram kernels; auto = "
+                          "pallas_t on TPU under the wave engine (f32, "
+                          "dense, serial/data), else onehot (TPU) / "
+                          "scatter",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
@@ -111,7 +118,7 @@ GROUPS = [
         "num_machines", "top_k", "local_listen_port", "time_out",
         "machine_list_file", "histogram_pool_size"]),
     ("TPU-native", [
-        "tpu_growth", "tpu_wave_width", "tpu_wave_chunk",
+        "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
         "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
         "tpu_use_dp", "tpu_profile_dir"]),
 ]
