@@ -63,6 +63,25 @@ def _bin_pad(num_bins: int) -> int:
     return ((num_bins + 127) // 128) * 128
 
 
+def _slot_hist(ohf, match, wc, W, hist_dtype, exact_order):
+    """One wave chunk's histogram contraction: (C, q) one-hot x per-child
+    masked weights -> (q, 3W).  Under exact order the contraction runs
+    per candidate slot in tpu_wave_width=1's operand shapes — one wide
+    GEMM's reduction order varies with the (C, 3W) width and would drift
+    from the pinned leaf-wise baseline by ulps.  ONE copy shared by
+    wave_pass and rehist so the bit-equality-critical layout cannot
+    diverge."""
+    c = ohf.shape[0]
+    if exact_order:
+        parts = [jnp.einsum("cq,cw->qw", ohf, match[:, w:w + 1] * wc,
+                            preferred_element_type=hist_dtype)
+                 for w in range(W)]
+        return jnp.concatenate(parts, axis=1)
+    wmat = (match[:, :, None] * wc[:, None, :]).reshape(c, 3 * W)
+    return jnp.einsum("cq,cw->qw", ohf, wmat,
+                      preferred_element_type=hist_dtype)
+
+
 def pallas_wave_active(hist_mode: str, hist_dtype=jnp.float32) -> bool:
     """True when a Pallas wave kernel will ACTUALLY run: TPU backend, f32
     accumulation (the kernels are single-dtype), and a pallas mode.  The
@@ -334,26 +353,9 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                              & valid[None, :]).astype(hist_dtype)
                     oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
                                         dtype=oh_dtype)      # (C, Fc, B)
-                    ohf = oh.reshape(c, Fc * hist_bins)
-                    if exact_order:
-                        # per-candidate GEMMs of exactly tpu_wave_width=1's
-                        # operand shape: XLA's reduction order varies with
-                        # the (C, 3W) width, so ONE wide contraction would
-                        # drift from the W=1 baseline by ulps — per-slot
-                        # contraction keeps exact-order trees bit-equal to
-                        # the pinned leaf-wise order
-                        parts = [jnp.einsum(
-                            "cq,cw->qw", ohf,
-                            match[:, w:w + 1] * wc,
-                            preferred_element_type=hist_dtype)
-                            for w in range(W)]
-                        acc = acc + jnp.concatenate(parts, axis=1)
-                    else:
-                        wmat = (match[:, :, None]
-                                * wc[:, None, :]).reshape(c, 3 * W)
-                        acc = acc + jnp.einsum(
-                            "cq,cw->qw", ohf, wmat,
-                            preferred_element_type=hist_dtype)
+                    acc = acc + _slot_hist(
+                        oh.reshape(c, Fc * hist_bins), match, wc, W,
+                        hist_dtype, exact_order)
                 return acc, lc2
 
             acc_shape = ((Fc * hist_bins, 3 * W) if not use_pallas_hist
@@ -391,20 +393,9 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                          & valid[None, :]).astype(hist_dtype)
                 oh = jax.nn.one_hot(xc.astype(jnp.int32), hist_bins,
                                     dtype=oh_dtype)
-                ohf = oh.reshape(c, Fc * hist_bins)
-                if exact_order:
-                    # W=1-shaped per-candidate GEMMs (see wave_pass)
-                    parts = [jnp.einsum(
-                        "cq,cw->qw", ohf, match[:, w:w + 1] * wc,
-                        preferred_element_type=hist_dtype)
-                        for w in range(W)]
-                    acc = acc + jnp.concatenate(parts, axis=1)
-                else:
-                    wmat = (match[:, :, None]
-                            * wc[:, None, :]).reshape(c, 3 * W)
-                    acc = acc + jnp.einsum(
-                        "cq,cw->qw", ohf, wmat,
-                        preferred_element_type=hist_dtype)
+                acc = acc + _slot_hist(
+                    oh.reshape(c, Fc * hist_bins), match, wc, W,
+                    hist_dtype, exact_order)
                 return acc, None
 
             init = jnp.zeros((Fc * hist_bins, 3 * W), dtype=hist_dtype)

@@ -126,6 +126,14 @@ FOLLOWUP = [
      {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 128}),
     ("engine onehot   W=32",
      {"kind": "dense", "n": 0, "mode": "onehot", "width": 32}),
+    # exact-order waves under the pallas kernel (the order-sensitive
+    # configs' new auto default): how many sweeps does exactness cost?
+    ("goss  auto exact W=16",
+     {"kind": "dense", "n": 0, "mode": "auto", "width": 16,
+      "extra": {"boosting": "goss", "tpu_wave_order": "exact"}}),
+    ("goss  auto W=1 (old)",
+     {"kind": "dense", "n": 0, "mode": "auto", "width": 1,
+      "extra": {"boosting": "goss"}}),
 ]
 
 
