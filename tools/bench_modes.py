@@ -22,9 +22,12 @@ def make_data(n_rows, n_features=28):
 
 
 def run(X, y, mode, wave_width=32, warmup=3, measured=10,
-        extra=None, train_set=None):
+        extra=None, train_set=None, details=False):
     """Time one engine config; X/y are ignored when a prebuilt train_set
-    (e.g. loaded from a .bin dataset cache) is passed instead."""
+    (e.g. loaded from a .bin dataset cache) is passed instead.  The ONE
+    copy of the measurement protocol (warmup -> block -> timed loop ->
+    block) — tpu_ab2 and bench_suite both go through it.  details=True
+    additionally returns the trained GBDT for learner introspection."""
     import jax
     import lightgbm_tpu as lgb
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
@@ -46,8 +49,10 @@ def run(X, y, mode, wave_width=32, warmup=3, measured=10,
         gbdt.train_one_iter(None, None, False)
     jax.block_until_ready(gbdt._score_dev)
     dt = (time.time() - t0) / measured
-    auc = gbdt.get_eval_at(0)[0]
-    return dt, auc
+    metric = gbdt.get_eval_at(0)[0]
+    if details:
+        return dt, metric, gbdt
+    return dt, metric
 
 
 def main():
