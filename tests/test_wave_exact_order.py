@@ -100,3 +100,21 @@ def test_exact_order_data_parallel_matches_w1():
     m1 = _model_string(params, X, y, {"tpu_wave_width": 1})
     mw = _model_string(params, X, y, {"tpu_wave_width": 8})
     assert mw == m1
+
+
+def test_exact_order_sparse_store_matches_w1():
+    """Exact order over the sparse coordinate store (tpu_sparse=true +
+    explicit wave growth): segment_sum histograms are per-segment
+    reductions in row order — W-invariant — so trees must match W=1."""
+    rng = np.random.default_rng(6)
+    n, f = 3000, 30
+    X = np.zeros((n, f))
+    nnz = int(n * f * 0.05)
+    X[rng.integers(0, n, nnz), rng.integers(0, f, nnz)] = \
+        rng.normal(size=nnz)
+    y = (X[:, 0] + X[:, 1] > 0.01).astype(np.float64)
+    params = dict(BASE, objective="binary", tpu_sparse=True,
+                  num_leaves=15)
+    m1 = _model_string(params, X, y, {"tpu_wave_width": 1})
+    mw = _model_string(params, X, y, {"tpu_wave_width": 8})
+    assert mw == m1
