@@ -97,10 +97,14 @@ def make_shape(name):
     return X, y, query
 
 
+def cache_path(name):
+    return "/tmp/suite_%s.bin" % name
+
+
 def cached_dataset(name):
     import lightgbm_tpu as lgb
     spec = SHAPES[name]
-    cache = "/tmp/suite_%s.bin" % name
+    cache = cache_path(name)
     if os.path.exists(cache):
         return lgb.Dataset(cache)
     X, y, query = make_shape(name)
