@@ -433,7 +433,10 @@ class _InnerPredictor:
             raise LightGBMError("Need booster or model_file")
 
     def predict_raw_for_init(self, features: np.ndarray) -> np.ndarray:
-        return self.gbdt.predict_raw(features)
+        # exact f64 host path: continued-training init scores feed the
+        # training parity contract (engine.py init_model), so the f32
+        # device bulk path must not round them
+        return self.gbdt.predict_raw(features, allow_device=False)
 
 
 class Booster:

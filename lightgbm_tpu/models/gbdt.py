@@ -592,14 +592,21 @@ class GBDT:
         return num_used
 
     def predict_raw(self, features: np.ndarray,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    allow_device: bool = True) -> np.ndarray:
         """Raw scores (N, num_tree_per_iteration) on real-valued features
-        (gbdt_prediction.cpp PredictRaw)."""
+        (gbdt_prediction.cpp PredictRaw).  allow_device=False pins the
+        exact f64 host path — continued-training init scores need it
+        (the device path's Kahan f32 accumulation is ~1e-7 relative)."""
         self._materialize()
         features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
         n = features.shape[0]
         k = self.num_tree_per_iteration
         num_used = self._used_trees(num_iteration)
+        dev = (self._device_bulk_predict(features, num_used, k)
+               if allow_device else None)
+        if dev is not None:
+            return dev
         from .. import native
         nat = native.predict_raw(
             [(self.models[t], t % k) for t in range(num_used)], k, features)
@@ -608,6 +615,50 @@ class GBDT:
         out = np.zeros((n, k), dtype=np.float64)
         for t in range(num_used):
             out[:, t % k] += self.models[t].predict(features)
+        return out
+
+    # ------------------------------------------------- device bulk predict
+    _DEVICE_PREDICT_MIN_ROWS = 100_000
+
+    def _device_bulk_predict(self, features, num_used, k):
+        """Rank-encoded TPU bulk prediction (ops/predict.py): f64-exact
+        routing as int compares, Kahan f32 accumulation.  Returns None
+        when the host paths should run instead (small batches, non-TPU
+        backends under tpu_predict=auto, tpu_predict=false, or a model
+        whose features mix categorical and numerical decisions)."""
+        from ..utils.config import _FALSE_SET, _TRUE_SET
+        cfg = str(getattr(self.config, "tpu_predict", "auto")).strip().lower()
+        if cfg in _FALSE_SET:
+            return None
+        if cfg not in _TRUE_SET:       # auto
+            if (jax.default_backend() != "tpu"
+                    or features.shape[0] < self._DEVICE_PREDICT_MIN_ROWS):
+                return None
+        key = (num_used, k, len(self.models), self.iter,
+               features.shape[1])
+        if getattr(self, "_ranked_pred_key", None) != key:
+            try:
+                self._ranked_pred = dev_predict.build_ranked_predictor(
+                    self.models[:num_used], k, features.shape[1])
+            except ValueError as e:    # mixed cat/num feature use
+                Log.warning("device bulk predict unavailable (%s); "
+                            "using the host predictor", e)
+                self._ranked_pred = None
+            self._ranked_pred_key = key
+        rp = self._ranked_pred
+        if rp is None:
+            return None
+        if features.shape[1] < rp.max_feature + 1:
+            return None                # fewer columns than the model uses
+        out = np.empty((features.shape[0], k), np.float64)
+        chunk = 4_000_000
+        for lo in range(0, features.shape[0], chunk):
+            part = features[lo:lo + chunk]
+            V, D = dev_predict.rank_encode(rp, part)
+            score = dev_predict.ranked_predict_device(
+                rp.dev, jnp.asarray(V), jnp.asarray(D), k)
+            out[lo:lo + len(part)] = np.asarray(jax.device_get(score),
+                                                np.float64)
         return out
 
     def predict(self, features: np.ndarray,

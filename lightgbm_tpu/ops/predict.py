@@ -139,3 +139,221 @@ def update_score_from_partition(score, leaf_id, leaf_value, scale):
 @jax.jit
 def add_constant_to_score(score, value):
     return score + value
+
+
+# --------------------------------------------------------------------------
+# Bulk prediction on RAW feature values, device-side (Predictor analog for
+# large batches).  The reference predicts row-wise on the host with f64
+# threshold compares (predictor.hpp:33-96, tree.h:250-276); a TPU bulk
+# path must keep those f64 decisions exact without paying f64 compute.
+# Trick: RANK ENCODING — per feature, collect every numerical threshold
+# any tree uses, sort-unique them ON THE HOST IN F64, and replace each
+# feature value by its insertion rank (count of thresholds < value).
+# Then `value <= threshold` == `rank(value) <= index(threshold)`: an
+# int32 compare on device, bit-faithful to the host decision.  NaN ranks
+# past every threshold (numpy sorts it last) -> goes right, matching the
+# C++ `operator<=` semantics.  Categorical nodes compare the int-cast
+# value directly; the zero-range default redirect becomes a per-node
+# "default goes left" bit (the node's default_value is a constant, so
+# its decision is host-computable).  Routing is therefore BIT-EQUAL
+# to the host predictor; leaf values accumulate in f32 with Kahan
+# compensation in fixed tree order (JAX's default x64-off mode cannot
+# hold f64 scores device-side), so outputs match the host's f64 sums to
+# f32 rounding (~1e-7 relative) with exact leaf assignment.
+# --------------------------------------------------------------------------
+
+_CAT_SENTINEL = -(2 ** 31) + 1
+
+
+class RankedTrees(NamedTuple):
+    """Stacked device arrays for the ranked traversal (a jit pytree)."""
+    feat: jnp.ndarray          # (T, M) i32 node split feature (outer idx)
+    thr: jnp.ndarray           # (T, M) i32 rank (num) or int value (cat)
+    is_cat: jnp.ndarray        # (T, M) i32
+    default_left: jnp.ndarray  # (T, M) i32 decision of the zero default
+    left: jnp.ndarray          # (T, M) i32
+    right: jnp.ndarray         # (T, M) i32
+    leaf_value: jnp.ndarray    # (T, L) f32 (shrinkage already baked in)
+    num_leaves: jnp.ndarray    # (T,) i32
+    tree_class: jnp.ndarray    # (T,) i32 class column per tree
+
+
+class RankedPredictor:
+    """Host-prepared state for device bulk prediction: the device tree
+    stack plus the HOST-ONLY rank tables (f64) and cat-feature set —
+    kept out of the jit pytree."""
+
+    def __init__(self, dev: "RankedTrees", thresholds: tuple,
+                 cat_features: frozenset, max_feature: int):
+        self.dev = dev
+        self.thresholds = thresholds
+        self.cat_features = cat_features
+        self.max_feature = max_feature     # host int: no sync per predict
+
+
+def build_ranked_predictor(models, num_class: int,
+                           num_features: int) -> "RankedPredictor":
+    """Pack host Trees into stacked device arrays + per-feature rank
+    tables.  Raises ValueError when a feature is used both numerically
+    and categorically (callers fall back to the host path)."""
+    import numpy as np
+
+    T = len(models)
+    M = max([max(t.num_leaves - 1, 1) for t in models] + [1])
+    L = max([max(t.num_leaves, 2) for t in models] + [2])
+    feat = np.zeros((T, M), np.int32)
+    thr_raw = np.zeros((T, M), np.float64)
+    is_cat = np.zeros((T, M), np.int32)
+    dleft = np.zeros((T, M), np.int32)
+    left = np.full((T, M), -1, np.int32)
+    right = np.full((T, M), -1, np.int32)
+    leaf_value = np.zeros((T, L), np.float64)
+    num_leaves = np.zeros(T, np.int32)
+    per_feature = {}
+    cat_features = set()
+    num_features_used = set()
+    for t, tree in enumerate(models):
+        ni = max(tree.num_leaves - 1, 0)
+        num_leaves[t] = tree.num_leaves
+        leaf_value[t, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+        if ni == 0:
+            continue
+        feat[t, :ni] = tree.split_feature[:ni]
+        thr_raw[t, :ni] = tree.threshold[:ni]
+        is_cat[t, :ni] = (tree.decision_type[:ni] == 1)
+        left[t, :ni] = tree.left_child[:ni]
+        right[t, :ni] = tree.right_child[:ni]
+        for nd in range(ni):
+            f = int(tree.split_feature[nd])
+            th = float(tree.threshold[nd])
+            dv = float(tree.default_value[nd])
+            if tree.decision_type[nd] == 1:
+                cat_features.add(f)
+                if abs(np.int64(th)) > 2 ** 31 - 2:
+                    # the device compares int32; an out-of-domain cat
+                    # threshold cannot be encoded without breaking the
+                    # bit-equal routing contract -> host path
+                    raise ValueError(
+                        "categorical threshold %r exceeds int32" % th)
+                dleft[t, nd] = int(np.int64(dv) == np.int64(th))
+            else:
+                num_features_used.add(f)
+                per_feature.setdefault(f, set()).add(th)
+                dleft[t, nd] = int(dv <= th)
+    mixed = cat_features & num_features_used
+    if mixed:
+        raise ValueError("features used both ways: %s" % sorted(mixed))
+
+    thresholds = []
+    thr_rank = np.zeros((T, M), np.int32)
+    for f in range(num_features):
+        arr = np.array(sorted(per_feature.get(f, ())), np.float64)
+        thresholds.append(arr)
+    for t, tree in enumerate(models):
+        ni = max(tree.num_leaves - 1, 0)
+        for nd in range(ni):
+            f = int(feat[t, nd])
+            if is_cat[t, nd]:
+                thr_rank[t, nd] = int(np.int64(thr_raw[t, nd]))
+            else:
+                thr_rank[t, nd] = int(np.searchsorted(
+                    thresholds[f], thr_raw[t, nd], side="left"))
+
+    tree_class = (jnp.arange(T, dtype=jnp.int32) % max(num_class, 1))
+    dev = RankedTrees(
+        feat=jnp.asarray(feat), thr=jnp.asarray(thr_rank),
+        is_cat=jnp.asarray(is_cat), default_left=jnp.asarray(dleft),
+        left=jnp.asarray(left), right=jnp.asarray(right),
+        leaf_value=jnp.asarray(leaf_value, jnp.float32),
+        num_leaves=jnp.asarray(num_leaves), tree_class=tree_class)
+    max_feature = int(feat.max()) if T else 0
+    return RankedPredictor(dev, tuple(thresholds),
+                           frozenset(cat_features), max_feature)
+
+
+def rank_encode(rp: "RankedPredictor", features) -> tuple:
+    """Host: (N, F) raw f64 values -> int32 rank/cat matrix + zero-range
+    mask.  All f64 decisions happen HERE (numpy), once per value."""
+    import numpy as np
+    from ..utils.common import kMissingValueRange
+
+    X = np.asarray(features, np.float64)
+    n, F = X.shape
+    V = np.zeros((n, F), np.int32)
+    for f in range(F):
+        col = X[:, f]
+        if f in rp.cat_features:
+            # kept domain |v| <= 2^31-2; anything outside maps to the
+            # sentinel, which can never equal an (in-domain, enforced at
+            # build) threshold — so out-of-range values route right
+            # exactly as the host int64 compare does
+            with np.errstate(invalid="ignore"):
+                iv = np.where(np.isfinite(col), col, 0.0).astype(np.int64)
+            V[:, f] = np.where(
+                np.isfinite(col) & (np.abs(iv) <= 2 ** 31 - 2),
+                iv, _CAT_SENTINEL).astype(np.int32)
+        else:
+            V[:, f] = np.searchsorted(rp.thresholds[f], col,
+                                      side="left").astype(np.int32)
+    D = (X > -kMissingValueRange) & (X <= kMissingValueRange)
+    return V, D
+
+
+def _ranked_leaf(slot, V, D, rows):
+    """Leaf index per row for one stacked tree slot (0 for stumps)."""
+    (feat, thr, cat, dl, lc, rc, lv, nl, cls) = slot
+    n = V.shape[0]
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        f = feat[nd]
+        v = V[rows, f]
+        gl = jnp.where(cat[nd] > 0, v == thr[nd], v <= thr[nd])
+        gl = jnp.where(D[rows, f], dl[nd] > 0, gl)
+        nxt = jnp.where(gl, lc[nd], rc[nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    init = jnp.where(nl > 1, jnp.zeros(n, jnp.int32),
+                     jnp.full(n, -1, jnp.int32))
+    node = lax.while_loop(cond, body, init)
+    return jnp.where(nl > 1, ~node, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def ranked_predict_device(dev: "RankedTrees", V, D, num_class: int):
+    """(N, num_class) f32 raw scores.  Leaf ROUTING is bit-equal to the
+    host f64 predictor (the ranks encode every f64 compare); values
+    accumulate with Kahan compensation in fixed tree order."""
+    n = V.shape[0]
+    rows = jnp.arange(n)
+
+    def one_tree(carry, slot):
+        score, comp = carry
+        lv, nl, cls = slot[6], slot[7], slot[8]
+        leaf = _ranked_leaf(slot, V, D, rows)
+        add = jnp.where(nl > 1, lv[leaf], jnp.zeros((), lv.dtype))
+        col_hit = (jnp.arange(num_class) == cls).astype(add.dtype)
+        y = add[:, None] * col_hit[None, :] - comp
+        t = score + y
+        comp = (t - score) - y
+        return (t, comp), None
+
+    init = (jnp.zeros((n, num_class), dev.leaf_value.dtype),
+            jnp.zeros((n, num_class), dev.leaf_value.dtype))
+    (score, _), _ = lax.scan(one_tree, init, tuple(dev))
+    return score
+
+
+@jax.jit
+def ranked_leaf_indices_device(dev: "RankedTrees", V, D):
+    """(N, T) leaf index per tree — the routing-exactness probe."""
+    rows = jnp.arange(V.shape[0])
+
+    def one(_, slot):
+        return None, _ranked_leaf(slot, V, D, rows)
+
+    _, leaves = lax.scan(one, None, tuple(dev))
+    return jnp.transpose(leaves)

@@ -132,7 +132,7 @@ PARAMETER_SET = {
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
-    "tpu_sparse", "tpu_wave_order",
+    "tpu_sparse", "tpu_wave_order", "tpu_predict",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -318,6 +318,10 @@ class Config:
         "machine_list_file": ("str", ""),
         # tpu-native additions
         "tpu_use_dp": ("bool", False),
+        # 'auto' | 'true' | 'false' — rank-encoded device bulk prediction
+        # (ops/predict.py): f64-exact routing as int32 compares on TPU.
+        # auto = device for >=100k-row batches on TPU, host otherwise.
+        "tpu_predict": ("str", "auto"),
         # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' |
         # 'pallas_f' | 'pallas_ft' — histogram kernel ('pallas' =
         # exact-engine per-leaf kernel, 'pallas_t' = wave kernel with

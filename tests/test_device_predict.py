@@ -1,0 +1,117 @@
+"""Rank-encoded device bulk prediction (ops/predict.py RankedPredictor):
+leaf ROUTING must be bit-equal to the host f64 predictor — the ranks
+encode every f64 threshold compare — including the zero-range default
+redirect, NaN-goes-right, and integer-cast categorical equality; scores
+match the host f64 sums to f32 rounding."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import predict as dev_predict
+
+
+def _train(X, y, params, rounds=10):
+    p = dict({"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5},
+             **params)
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                     num_boost_round=rounds)
+
+
+def _routing_and_scores(bst, Xq):
+    g = bst._gbdt
+    g._materialize()
+    k = g.num_tree_per_iteration
+    rp = dev_predict.build_ranked_predictor(g.models, k, Xq.shape[1])
+    V, D = dev_predict.rank_encode(rp, Xq)
+    import jax.numpy as jnp
+    leaves = np.asarray(dev_predict.ranked_leaf_indices_device(
+        rp.dev, jnp.asarray(V), jnp.asarray(D)))
+    score = np.asarray(dev_predict.ranked_predict_device(
+        rp.dev, jnp.asarray(V), jnp.asarray(D), k))
+    host_leaves = np.stack(
+        [t.predict_leaf_index(np.asarray(Xq, np.float64))
+         for t in g.models], axis=1)
+    host_raw = np.zeros((len(Xq), k))
+    for t, tree in enumerate(g.models):
+        host_raw[:, t % k] += tree.predict(np.asarray(Xq, np.float64))
+    return leaves, host_leaves, score, host_raw
+
+
+def test_routing_bit_equal_binary_with_zeros_and_nan():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(4000, 6))
+    X[rng.random(X.shape) < 0.2] = 0.0          # exercise zero redirect
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = _train(X, y, {"objective": "binary"})
+    Xq = X.copy()
+    Xq[rng.random(Xq.shape) < 0.05] = np.nan    # NaN -> right
+    Xq[rng.random(Xq.shape) < 0.05] = 0.0
+    leaves, host_leaves, score, host_raw = _routing_and_scores(bst, Xq)
+    np.testing.assert_array_equal(leaves, host_leaves)
+    np.testing.assert_allclose(score, host_raw, rtol=2e-6, atol=2e-6)
+
+
+def test_routing_bit_equal_categorical_multiclass():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(3000, 5))
+    X[:, 0] = rng.integers(0, 12, size=3000)
+    X[:, 1] = rng.integers(0, 5, size=3000)
+    y = rng.integers(0, 3, size=3000).astype(np.float64)
+    bst = _train(X, y, {"objective": "multiclass", "num_class": 3,
+                        "categorical_feature": [0, 1]}, rounds=5)
+    Xq = X.copy()
+    Xq[:50, 0] = 99.0                           # unseen category
+    leaves, host_leaves, score, host_raw = _routing_and_scores(bst, Xq)
+    np.testing.assert_array_equal(leaves, host_leaves)
+    np.testing.assert_allclose(score, host_raw, rtol=2e-6, atol=2e-6)
+
+
+def test_bulk_predict_engages_and_matches(monkeypatch):
+    """tpu_predict=true forces the device path through Booster.predict;
+    results match the host path (tpu_predict=false) to f32 rounding."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(2500, 6))
+    y = X[:, 0] * 2 + X[:, 2] + 0.1 * rng.normal(size=2500)
+    bst = _train(X, y, {"objective": "regression"})
+    g = bst._gbdt
+    g.config = g.config.copy_with(tpu_predict="true")
+    p_dev = bst.predict(X)
+    calls = {"n": 0}
+    orig = dev_predict.ranked_predict_device
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+    monkeypatch.setattr(dev_predict, "ranked_predict_device", spy)
+    g.config = g.config.copy_with(tpu_predict="true")
+    g._ranked_pred_key = None
+    p_dev2 = bst.predict(X)
+    assert calls["n"] >= 1, "device path did not engage"
+    g.config = g.config.copy_with(tpu_predict="false")
+    p_host = bst.predict(X)
+    np.testing.assert_allclose(p_dev, p_host, rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(p_dev2, p_host, rtol=2e-6, atol=2e-6)
+
+
+def test_loaded_model_device_predict(tmp_path):
+    """A Booster loaded from a model FILE (real-valued thresholds only)
+    routes identically on device."""
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(2000, 5))
+    y = (X[:, 0] - 0.3 * X[:, 4] > 0).astype(np.float64)
+    bst = _train(X, y, {"objective": "binary"})
+    fn = str(tmp_path / "m.txt")
+    bst.save_model(fn)
+    loaded = lgb.Booster(model_file=fn)
+    g = loaded._gbdt
+    g._materialize()
+    rp = dev_predict.build_ranked_predictor(
+        g.models, g.num_tree_per_iteration, X.shape[1])
+    V, D = dev_predict.rank_encode(rp, X)
+    import jax.numpy as jnp
+    leaves = np.asarray(dev_predict.ranked_leaf_indices_device(
+        rp.dev, jnp.asarray(V), jnp.asarray(D)))
+    host_leaves = np.stack(
+        [t.predict_leaf_index(np.asarray(X, np.float64))
+         for t in g.models], axis=1)
+    np.testing.assert_array_equal(leaves, host_leaves)

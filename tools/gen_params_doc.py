@@ -81,6 +81,9 @@ NOTES = {
                   "engine, serial + data-parallel; histograms from "
                   "nonzeros only)",
     "tpu_use_dp": "float64 histograms/scores (gpu_use_dp analog)",
+    "tpu_predict": "auto / true / false — rank-encoded device bulk "
+                   "prediction (f64-exact routing as int compares; auto "
+                   "= device for >=100k-row batches on TPU)",
     "tpu_profile_dir": "write a jax.profiler trace per training run",
 }
 
@@ -120,7 +123,7 @@ GROUPS = [
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
         "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
-        "tpu_use_dp", "tpu_profile_dir"]),
+        "tpu_use_dp", "tpu_predict", "tpu_profile_dir"]),
 ]
 
 
