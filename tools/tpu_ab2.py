@@ -134,6 +134,18 @@ FOLLOWUP = [
     ("goss  auto W=1 (old)",
      {"kind": "dense", "n": 0, "mode": "auto", "width": 1,
       "extra": {"boosting": "goss"}}),
+    # bosch sparse arms: re-queued from the main list (its 6h window
+    # can expire with these unmeasured; the binned-dataset cache makes
+    # retries cheap once one build lands)
+    ("bosch1Mx968 sparse exact",
+     {"kind": "sparse", "n": 1_000_000, "width": 1, "timeout": 2700,
+      "extra": {"tpu_sparse": True, "tpu_growth": "exact"}}),
+    ("bosch1Mx968 sparse wave8",
+     {"kind": "sparse", "n": 1_000_000, "width": 8, "timeout": 2700,
+      "extra": {"tpu_sparse": True, "tpu_growth": "wave"}}),
+    ("bosch1Mx968 dense  exact",
+     {"kind": "sparse", "n": 1_000_000, "width": 1, "timeout": 2700,
+      "extra": {"tpu_growth": "exact"}}),
 ]
 
 
