@@ -118,3 +118,27 @@ def test_exact_order_sparse_store_matches_w1():
     m1 = _model_string(params, X, y, {"tpu_wave_width": 1})
     mw = _model_string(params, X, y, {"tpu_wave_width": 8})
     assert mw == m1
+
+
+def test_exact_order_bundled_matches_w1():
+    """EFB-bundled data exercises the split table's group remap columns
+    (goff/adjust/span) — exact order must stay W-invariant there too."""
+    rng = np.random.default_rng(7)
+    n = 2400
+    parts = []
+    for k in (4, 5, 6):                      # one-hot blocks -> bundles
+        codes = rng.integers(0, k, size=n)
+        parts.append(np.eye(k)[codes])
+    dense = rng.normal(size=(n, 3))
+    X = np.concatenate(parts + [dense], axis=1)
+    y = (dense[:, 0] + (X[:, 0] > 0) - 0.5 * (X[:, 6] > 0)
+         > 0.2).astype(np.float64)
+    params = dict(BASE, objective="binary", num_leaves=23)
+    m1 = _model_string(params, X, y, {"tpu_wave_width": 1})
+    mw = _model_string(params, X, y, {"tpu_wave_width": 8})
+    assert mw == m1
+    # sanity: the dataset actually bundled (EFB engaged)
+    import lightgbm_tpu as lgb
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    ds.construct()
+    assert ds._handle.bundle is not None
