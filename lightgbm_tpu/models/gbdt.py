@@ -650,15 +650,26 @@ class GBDT:
             return None
         if features.shape[1] < rp.max_feature + 1:
             return None                # fewer columns than the model uses
+        devices = jax.local_devices()   # per-process rows -> local mesh
         out = np.empty((features.shape[0], k), np.float64)
-        chunk = 4_000_000
+        # host V (i32) + D (bool) cost F*5 bytes/row; cap the chunk so the
+        # encode buffers stay ~<=6 GB however many devices/features
+        bytes_per_row = max(features.shape[1], 1) * 5
+        chunk = min(4_000_000 * max(len(devices), 1),
+                    max(1_000_000, 6_000_000_000 // bytes_per_row))
         for lo in range(0, features.shape[0], chunk):
             part = features[lo:lo + chunk]
             V, D = dev_predict.rank_encode(rp, part)
-            score = dev_predict.ranked_predict_device(
-                rp.dev, jnp.asarray(V), jnp.asarray(D), k)
-            out[lo:lo + len(part)] = np.asarray(jax.device_get(score),
-                                                np.float64)
+            if len(devices) > 1:
+                # rows shard over the device mesh; trees replicate —
+                # bit-identical to single-device (pure data parallel)
+                score, nrows = dev_predict.ranked_predict_sharded(
+                    rp, V, D, k, devices=devices)
+                score = jax.device_get(score)[:nrows]
+            else:
+                score = jax.device_get(dev_predict.ranked_predict_device(
+                    rp.dev, jnp.asarray(V), jnp.asarray(D), k))
+            out[lo:lo + len(part)] = np.asarray(score, np.float64)
         return out
 
     def predict(self, features: np.ndarray,

@@ -299,7 +299,7 @@ def rank_encode(rp: "RankedPredictor", features) -> tuple:
     return V, D
 
 
-def _ranked_leaf(slot, V, D, rows):
+def _ranked_leaf(slot, V, D, rows, vary_axis=None):
     """Leaf index per row for one stacked tree slot (0 for stumps)."""
     (feat, thr, cat, dl, lc, rc, lv, nl, cls) = slot
     n = V.shape[0]
@@ -318,22 +318,27 @@ def _ranked_leaf(slot, V, D, rows):
 
     init = jnp.where(nl > 1, jnp.zeros(n, jnp.int32),
                      jnp.full(n, -1, jnp.int32))
+    if vary_axis is not None:
+        # under shard_map the carry must be shard-varying like the body
+        # output (which reads the row-sharded V/D); init alone is built
+        # from replicated tree arrays, so cast it explicitly
+        from .grow import pvary_for
+        init = pvary_for(init, vary_axis)
     node = lax.while_loop(cond, body, init)
     return jnp.where(nl > 1, ~node, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_class",))
-def ranked_predict_device(dev: "RankedTrees", V, D, num_class: int):
-    """(N, num_class) f32 raw scores.  Leaf ROUTING is bit-equal to the
-    host f64 predictor (the ranks encode every f64 compare); values
-    accumulate with Kahan compensation in fixed tree order."""
+def _ranked_predict_impl(dev: "RankedTrees", V, D, num_class: int,
+                         vary_axis=None):
+    """Traceable body of ranked prediction (shared by the single-device
+    jit and the per-shard program in ``ranked_predict_sharded``)."""
     n = V.shape[0]
     rows = jnp.arange(n)
 
     def one_tree(carry, slot):
         score, comp = carry
         lv, nl, cls = slot[6], slot[7], slot[8]
-        leaf = _ranked_leaf(slot, V, D, rows)
+        leaf = _ranked_leaf(slot, V, D, rows, vary_axis)
         add = jnp.where(nl > 1, lv[leaf], jnp.zeros((), lv.dtype))
         col_hit = (jnp.arange(num_class) == cls).astype(add.dtype)
         y = add[:, None] * col_hit[None, :] - comp
@@ -343,8 +348,19 @@ def ranked_predict_device(dev: "RankedTrees", V, D, num_class: int):
 
     init = (jnp.zeros((n, num_class), dev.leaf_value.dtype),
             jnp.zeros((n, num_class), dev.leaf_value.dtype))
+    if vary_axis is not None:
+        from .grow import pvary_for
+        init = tuple(pvary_for(a, vary_axis) for a in init)
     (score, _), _ = lax.scan(one_tree, init, tuple(dev))
     return score
+
+
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def ranked_predict_device(dev: "RankedTrees", V, D, num_class: int):
+    """(N, num_class) f32 raw scores.  Leaf ROUTING is bit-equal to the
+    host f64 predictor (the ranks encode every f64 compare); values
+    accumulate with Kahan compensation in fixed tree order."""
+    return _ranked_predict_impl(dev, V, D, num_class)
 
 
 @jax.jit
@@ -357,3 +373,82 @@ def ranked_leaf_indices_device(dev: "RankedTrees", V, D):
 
     _, leaves = lax.scan(one, None, tuple(dev))
     return jnp.transpose(leaves)
+
+
+def _sharded_predict_ctx(rp: "RankedPredictor", num_class: int, devices):
+    """Build (once per device set) the mesh, the replicated tree stack,
+    and the jitted shard_map program for row-sharded prediction; cached
+    on the RankedPredictor so the chunk loop pays one model broadcast
+    per predict call, not one per chunk."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import (DATA_AXIS, _shard_map_compat,
+                                 make_data_mesh)
+
+    key = (tuple(devices), num_class)
+    cached = getattr(rp, "_shard_ctx", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    mesh = make_data_mesh(devices)
+    repl = NamedSharding(mesh, P())
+    rows_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    dev_repl = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, repl), rp.dev)
+
+    # per-shard program: each device runs the traversal on ITS rows only,
+    # so the while_loop's `any(node >= 0)` cond reduces locally — no
+    # per-step cross-device all-reduce, zero collectives end to end
+    def _local(dev_, V_, D_):
+        return _ranked_predict_impl(dev_, V_, D_, num_class,
+                                    vary_axis=DATA_AXIS)
+
+    fn = jax.jit(_shard_map_compat(
+        _local, mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS, None)))
+    ctx = (rows_sh, dev_repl, fn)
+    rp._shard_ctx = (key, ctx)
+    return ctx
+
+
+def ranked_predict_sharded(rp: "RankedPredictor", V, D, num_class: int,
+                           devices=None):
+    """Row-sharded bulk prediction over a 1-D LOCAL device mesh.
+
+    Prediction is embarrassingly parallel in rows, so the multi-chip
+    design is pure data parallelism: the tree stack is replicated to
+    every local device, host V/D rows are placed directly with a
+    row-sharded NamedSharding (each shard streams host→owning-device;
+    nothing stages on device 0), and the traversal runs under shard_map
+    so every device's while_loop terminates on its own rows.  Per-row
+    arithmetic (the tree scan with Kahan compensation) is unchanged, so
+    the result is bit-identical to the single-device path.
+
+    Multi-process: each process predicts ITS OWN rows over its local
+    devices only — matching the reference's per-rank prediction
+    (src/application/application.cpp Predict runs per-rank on local
+    rows); no global mesh, so nothing is placed on non-addressable
+    devices.
+
+    V/D may be numpy arrays; returns (scores, n) where rows n: are pad.
+    """
+    import numpy as np
+
+    if devices is None:
+        devices = jax.local_devices()
+    ndev = len(devices)
+    n = V.shape[0]
+    if ndev <= 1:
+        return ranked_predict_device(
+            rp.dev, jnp.asarray(V), jnp.asarray(D), num_class), n
+    rows_sh, dev_repl, fn = _sharded_predict_ctx(rp, num_class, devices)
+    pad = (-n) % ndev
+    if pad:
+        # padded rows traverse with rank 0 / in-range flags; sliced off
+        # by the caller, so their values are irrelevant
+        V = np.concatenate([np.asarray(V),
+                            np.zeros((pad, V.shape[1]), V.dtype)])
+        D = np.concatenate([np.asarray(D),
+                            np.zeros((pad, D.shape[1]), D.dtype)])
+    V = jax.device_put(np.ascontiguousarray(V), rows_sh)
+    D = jax.device_put(np.ascontiguousarray(D), rows_sh)
+    return fn(dev_repl, V, D), n

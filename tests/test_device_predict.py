@@ -77,12 +77,19 @@ def test_bulk_predict_engages_and_matches(monkeypatch):
     g.config = g.config.copy_with(tpu_predict="true")
     p_dev = bst.predict(X)
     calls = {"n": 0}
-    orig = dev_predict.ranked_predict_device
+    orig_one = dev_predict.ranked_predict_device
+    orig_sh = dev_predict.ranked_predict_sharded
 
-    def spy(*a, **kw):
+    def spy_one(*a, **kw):
         calls["n"] += 1
-        return orig(*a, **kw)
-    monkeypatch.setattr(dev_predict, "ranked_predict_device", spy)
+        return orig_one(*a, **kw)
+
+    def spy_sh(*a, **kw):
+        calls["n"] += 1
+        return orig_sh(*a, **kw)
+    # multi-device backends route through the sharded program instead
+    monkeypatch.setattr(dev_predict, "ranked_predict_device", spy_one)
+    monkeypatch.setattr(dev_predict, "ranked_predict_sharded", spy_sh)
     g.config = g.config.copy_with(tpu_predict="true")
     g._ranked_pred_key = None
     p_dev2 = bst.predict(X)
@@ -115,3 +122,55 @@ def test_loaded_model_device_predict(tmp_path):
         [t.predict_leaf_index(np.asarray(X, np.float64))
          for t in g.models], axis=1)
     np.testing.assert_array_equal(leaves, host_leaves)
+
+def test_sharded_predict_matches_single_device():
+    """ranked_predict_sharded over the 8-device CPU mesh is bit-identical
+    to the single-device program — prediction is pure data parallelism
+    (rows shard, trees replicate, zero collectives)."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1003, 6))          # deliberately not %8 == 0
+    X[rng.random(X.shape) < 0.1] = 0.0
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = _train(X, y, {"objective": "binary"})
+    g = bst._gbdt
+    g._materialize()
+    k = g.num_tree_per_iteration
+    rp = dev_predict.build_ranked_predictor(g.models, k, X.shape[1])
+    V, D = dev_predict.rank_encode(rp, X)
+    single = np.asarray(dev_predict.ranked_predict_device(
+        rp.dev, jnp.asarray(V), jnp.asarray(D), k))
+    sharded, nrows = dev_predict.ranked_predict_sharded(
+        rp, V, D, k, devices=jax.devices()[:8])
+    assert nrows == len(X)
+    np.testing.assert_array_equal(np.asarray(sharded)[:nrows], single)
+    # ctx is cached: a second call reuses the replicated tree stack
+    ctx1 = rp._shard_ctx
+    sharded2, _ = dev_predict.ranked_predict_sharded(
+        rp, V, D, k, devices=jax.devices()[:8])
+    assert rp._shard_ctx is ctx1
+    np.testing.assert_array_equal(np.asarray(sharded2), np.asarray(sharded))
+
+
+def test_sharded_predict_through_booster(monkeypatch):
+    """tpu_predict=true on a multi-device backend routes Booster.predict
+    through the sharded program and matches the host predictor."""
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(2000, 5))
+    y = X[:, 0] - X[:, 3] + 0.1 * rng.normal(size=2000)
+    bst = _train(X, y, {"objective": "regression"})
+    g = bst._gbdt
+    calls = {"n": 0}
+    orig = dev_predict.ranked_predict_sharded
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+    monkeypatch.setattr(dev_predict, "ranked_predict_sharded", spy)
+    g.config = g.config.copy_with(tpu_predict="true")
+    p_dev = bst.predict(X)
+    assert calls["n"] >= 1, "sharded path did not engage"
+    g.config = g.config.copy_with(tpu_predict="false")
+    p_host = bst.predict(X)
+    np.testing.assert_allclose(p_dev, p_host, rtol=2e-6, atol=2e-6)
