@@ -656,7 +656,7 @@ class GBDT:
         # encode buffers stay ~<=6 GB however many devices/features
         bytes_per_row = max(features.shape[1], 1) * 5
         chunk = min(4_000_000 * max(len(devices), 1),
-                    max(1_000_000, 6_000_000_000 // bytes_per_row))
+                    max(65_536, 6_000_000_000 // bytes_per_row))
         for lo in range(0, features.shape[0], chunk):
             part = features[lo:lo + chunk]
             V, D = dev_predict.rank_encode(rp, part)

@@ -315,6 +315,22 @@ class SerialTreeLearner:
         self.wave_width = (resolve_wave_width(config, self.num_leaves,
                                               self.wave_order)
                            if growth == "wave" else 1)
+        lk = str(config.tpu_wave_lookup).strip().lower()
+        if growth == "wave":
+            if lk not in ("auto", "onehot", "compact", "gather"):
+                Log.fatal("Unknown tpu_wave_lookup %s (expected auto/"
+                          "onehot/compact/gather)", config.tpu_wave_lookup)
+            # auto stays onehot until the on-chip A/B picks a winner
+            self.wave_lookup = "onehot" if lk == "auto" else lk
+            if lk != "auto" and (hist_mode in ("pallas_f", "pallas_ft")
+                                 or sparse_on):
+                Log.warning("tpu_wave_lookup=%s has no effect under %s "
+                            "(the fused kernels / sparse pass own their "
+                            "own lookup)", lk,
+                            "tpu_sparse" if sparse_on
+                            else "tpu_histogram_mode=%s" % hist_mode)
+        else:
+            self.wave_lookup = "onehot"
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when
         # every device column fits a nibble, store TWO columns per byte in
         # HBM; the growth engines unpack per chunk/column in-scan, so the
@@ -440,7 +456,8 @@ class SerialTreeLearner:
                 self.bundle_arrays is not None, self.group_bins,
                 self.cache_hists, hist_mode,
                 int(config.tpu_wave_chunk), self.packed_cols,
-                self.sparse_col_cap, self.wave_order == "exact")
+                self.sparse_col_cap, self.wave_order == "exact",
+                self.wave_lookup)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;

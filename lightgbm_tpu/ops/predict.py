@@ -441,7 +441,8 @@ def ranked_predict_sharded(rp: "RankedPredictor", V, D, num_class: int,
         return ranked_predict_device(
             rp.dev, jnp.asarray(V), jnp.asarray(D), num_class), n
     rows_sh, dev_repl, fn = _sharded_predict_ctx(rp, num_class, devices)
-    pad = (-n) % ndev
+    from ..parallel.mesh import pad_rows
+    pad = pad_rows(n, ndev)
     if pad:
         # padded rows traverse with rank 0 / in-range flags; sliced off
         # by the caller, so their values are irrelevant

@@ -107,7 +107,8 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       group_bins: int = 0, cache_hists: bool = True,
                       hist_mode: str = "onehot", chunk: int = 16384,
                       packed_cols: int = 0, sparse_col_cap: int = 0,
-                      with_xt: bool = False, exact_order: bool = False):
+                      with_xt: bool = False, exact_order: bool = False,
+                      lookup: str = "onehot"):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id)).
@@ -121,7 +122,7 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
                           hist_mode, chunk, packed_cols, sparse_col_cap,
-                          exact_order)
+                          exact_order, lookup)
 
     if with_xt:
         def grow(X, grad, hess, row_mult, feature_mask, Xt):
@@ -149,7 +150,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    psum_axis: str, has_bundle: bool, group_bins: int,
                    cache_hists: bool, hist_mode: str, chunk: int,
                    packed_cols: int = 0, sparse_col_cap: int = 0,
-                   exact_order: bool = False):
+                   exact_order: bool = False, lookup: str = "onehot"):
     """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
     byte) and packed_cols is the LOGICAL column count; every chunk is
     unpacked in-scan so the full-width matrix never hits HBM (the
@@ -297,15 +298,25 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             return wave_histogram_pallas(X, lid, w3, cid, hist_bins,
                                          logical_cols=packed_cols)
 
-        def wave_pass(leaf_id, tbl, small_id, valid):
+        def wave_pass(leaf_id, tbl, cols, psrc, small_id, valid):
             """Partition + child histograms, fused into ONE chunked sweep.
 
-            Per chunk: rows look up their leaf's split row in the (L, 10)
-            table via a one-hot contraction, route left/right (the
+            Per chunk: rows look up their leaf's split row in the split
+            table (`lookup` strategy below), route left/right (the
             partition), then the chunk's bin one-hot (C, Fc*B) is contracted
             against per-child masked weights (C, 3W) on the MXU.  Nothing
             N x L or N x W is ever materialized.  Shard-local; callers psum
             the histogram block.
+
+            Lookup strategies for the per-row split row `r` (C, 10):
+            - 'onehot': (C, L) leaf one-hot @ (L, 10) table on the MXU —
+              exact f32, but the one-hot costs L*4 bytes/row of traffic.
+            - 'compact': each row matches at most ONE of the W wave
+              parents (splits are disjoint), so r is a masked sum over
+              the (W, 10) rows — W/L of the one-hot footprint and the
+              sum has <=1 nonzero term (exact in any order).
+            - 'gather': r = tbl[leaf_id] — the form the sparse pass
+              already uses; XLA's TPU gather economics decide.
 
             On TPU the histogram half runs as the fused Pallas kernel
             (one-hot generated in VMEM, ops/pallas_wave.py) and the scan
@@ -335,13 +346,25 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             def step(acc, args):
                 xc, lc, wc = args                   # (C,Fdev) (C,) (C,3)
                 xc = unpack(xc)                     # (C, Fc) logical bins
-                leaf_oh = (lc[:, None] == l_iota[None, :]).astype(
-                    jnp.float32)                    # (C, L)
-                # HIGHEST: TPU's default matmul precision is bf16, which
-                # rounds integer table entries above 256 (column ids,
-                # thresholds, leaf ids) — the lookup must be exact f32
-                r = jnp.matmul(leaf_oh, tbl,
-                               precision=lax.Precision.HIGHEST)  # (C, 10)
+                if lookup == "compact":
+                    # <=1 match per row, so the sum is exact and XLA can
+                    # fuse the (C, W, 10) broadcast into the reduction —
+                    # no (C, L) one-hot ever exists
+                    pm = lc[:, None] == psrc[None, :]          # (C, W)
+                    r = jnp.sum(
+                        jnp.where(pm[:, :, None], cols[None, :, :], 0.0),
+                        axis=1)                     # (C, 10)
+                elif lookup == "gather":
+                    r = jnp.take(tbl, jnp.clip(lc, 0, L - 1), axis=0)
+                else:
+                    leaf_oh = (lc[:, None] == l_iota[None, :]).astype(
+                        jnp.float32)                # (C, L)
+                    # HIGHEST: TPU's default matmul precision is bf16,
+                    # which rounds integer table entries above 256 (column
+                    # ids, thresholds, leaf ids) — the lookup must be
+                    # exact f32
+                    r = jnp.matmul(leaf_oh, tbl,
+                                   precision=lax.Precision.HIGHEST)
                 cj = r[:, 1].astype(jnp.int32)
                 colv = jnp.sum(
                     jnp.where(cj[:, None] == f_iota[None, :], xc, 0)
@@ -511,6 +534,11 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             ], axis=-1)                                    # (W, 10)
             tbl = jnp.zeros((L, 10), jnp.float32).at[src].set(
                 cols, mode="drop")
+            # compact-lookup operands: the W parent ids (invalid slots
+            # get -3, which no real/padded leaf id ever equals) and the
+            # raw (W, 10) rows — invalid rows can hold garbage, they
+            # never match
+            psrc = jnp.where(valid, parent, -3)
 
             # ---- fused partition + children histograms (one sweep)
             left_small = info[:, LEFT_COUNT] < info[:, RIGHT_COUNT]
@@ -520,8 +548,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 leaf_id, hist_small = sparse_wave_pass(
                     leaf_id, tbl, small_id, valid, col_w)
             else:
-                leaf_id, hist_small = wave_pass(leaf_id, tbl, small_id,
-                                                valid)
+                leaf_id, hist_small = wave_pass(leaf_id, tbl, cols, psrc,
+                                                small_id, valid)
             hist_small = maybe_psum(hist_small)             # (W, F, B, 3)
             if cache_hists:
                 hist_large = hists[parent] - hist_small

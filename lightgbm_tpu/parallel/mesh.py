@@ -213,7 +213,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 cache_hists=self.cache_hists, hist_mode=self.hist_mode,
                 chunk=int(config.tpu_wave_chunk),
                 sparse_col_cap=self.sparse_col_cap, with_xt=needs_xt,
-                exact_order=self.wave_order == "exact")
+                exact_order=self.wave_order == "exact",
+                lookup=self.wave_lookup)
             if needs_xt:
                 self._Xt = jax.jit(
                     jnp.transpose,

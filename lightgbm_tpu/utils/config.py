@@ -132,7 +132,7 @@ PARAMETER_SET = {
     # tpu-native additions
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
-    "tpu_sparse", "tpu_wave_order", "tpu_predict",
+    "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -353,6 +353,14 @@ class Config:
         # auto -> exact for order-sensitive configs (lambdarank, DART,
         # GOSS, InfiniteBoost), batched otherwise.
         "tpu_wave_order": ("str", "auto"),
+        # 'auto' | 'onehot' | 'compact' | 'gather' — how the wave
+        # partition scan looks up each row's pending split: 'onehot'
+        # contracts a (chunk, num_leaves) leaf one-hot against the
+        # (L, 10) split table on the MXU; 'compact' matches rows against
+        # only the W wave parents (<=1 match per row, so the masked sum
+        # is exact) — W/L of the one-hot footprint; 'gather' indexes the
+        # table directly.  auto -> onehot pending on-chip A/B.
+        "tpu_wave_lookup": ("str", "auto"),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
         # (VMEM-residency vs scan-overhead tradeoff on TPU; engine

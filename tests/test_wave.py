@@ -236,3 +236,37 @@ def test_wave_width_auto_ranking_quality_gate():
     # plain GBDT keeps the speed ladder
     assert resolve_wave_width(Config({"verbose": -1,
                                       "objective": "binary"}), 255) == 32
+
+
+def test_wave_lookup_modes_identical_trees():
+    """The three partition-lookup strategies (onehot / compact / gather)
+    are algebraically identical — each row's split row r is the same
+    exact f32 vector — so full trainings must produce byte-identical
+    models, including under EFB bundling and at several widths."""
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(4000, 10))
+    X[rng.random(X.shape) < 0.15] = 0.0
+    y = (X[:, 0] - 0.5 * X[:, 3] + 0.2 * rng.normal(size=4000) > 0)
+    base = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+            "min_data_in_leaf": 5, "tpu_growth": "wave"}
+    for width in (4, 8):
+        models = {}
+        for lk in ("onehot", "compact", "gather"):
+            p = dict(base, tpu_wave_width=width, tpu_wave_lookup=lk)
+            bst = lgb.train(p, lgb.Dataset(X, label=y.astype(np.float64),
+                                           params=p), num_boost_round=8)
+            models[lk] = bst.model_to_string()
+        assert models["compact"] == models["onehot"], \
+            "compact lookup diverged at W=%d" % width
+        assert models["gather"] == models["onehot"], \
+            "gather lookup diverged at W=%d" % width
+
+
+def test_wave_lookup_validation():
+    p = {"objective": "binary", "verbose": -1, "tpu_growth": "wave",
+         "tpu_wave_lookup": "bogus"}
+    X = np.random.default_rng(0).normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)

@@ -60,6 +60,15 @@ SHAPES = {
         "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
         "categorical_feature": ",".join(str(i) for i in range(10))},
         warmup=2, measured=5, timeout=2700, n_cat=10, cardinality=100),
+    # width arm at the FLAGSHIP shape: at 1M the W=64 arm lost to W=32
+    # (fixed per-wave cost dominates); at 10.5M each sweep is a full
+    # pass over 10x the rows, so halving sweeps/tree may flip the
+    # economics — measure, don't extrapolate
+    "higgs_w64": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_t", "tpu_wave_width": 64},
+        warmup=3, measured=10, timeout=2700),
 }
 
 

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Round-3 continuation chain: fire the flagship bench + suite arms the
+# moment the A/B runner exits, so no tunnel window is wasted.
+cd /root/repo
+while pgrep -f "tpu_ab2.py 999424" > /dev/null; do sleep 60; done
+echo "[chain] A/B finished at $(date -u)" >> /tmp/chain_r03.log
+python bench.py > /tmp/bench_r03.out 2> /tmp/bench_r03.err
+echo "[chain] bench rc=$? at $(date -u)" >> /tmp/chain_r03.log
+python tools/bench_suite.py higgs higgs_w64 epsilon epsilon_p16 msltr expo_cat \
+  >> /tmp/chain_r03.log 2>&1
+echo "[chain] suite rc=$? at $(date -u)" >> /tmp/chain_r03.log
