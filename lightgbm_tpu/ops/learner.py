@@ -320,8 +320,16 @@ class SerialTreeLearner:
             if lk not in ("auto", "onehot", "compact", "gather"):
                 Log.fatal("Unknown tpu_wave_lookup %s (expected auto/"
                           "onehot/compact/gather)", config.tpu_wave_lookup)
-            # auto stays onehot until the on-chip A/B picks a winner
-            self.wave_lookup = "onehot" if lk == "auto" else lk
+            # auto -> compact on TPU (measured on v5e at 1Mx28/255
+            # leaves/W=32: 7.12 it/s vs onehot-lookup's 6.34 on the XLA
+            # engine — the (C, L) leaf one-hot was ~L/W of pure traffic);
+            # onehot elsewhere (CPU layouts don't pay the lane padding)
+            if lk == "auto":
+                self.wave_lookup = ("compact"
+                                    if jax.default_backend() == "tpu"
+                                    else "onehot")
+            else:
+                self.wave_lookup = lk
             if lk != "auto" and (hist_mode in ("pallas_f", "pallas_ft")
                                  or sparse_on):
                 Log.warning("tpu_wave_lookup=%s has no effect under %s "
@@ -329,6 +337,17 @@ class SerialTreeLearner:
                             "own lookup)", lk,
                             "tpu_sparse" if sparse_on
                             else "tpu_histogram_mode=%s" % hist_mode)
+            if (hist_mode in ("pallas_f", "pallas_ft")
+                    and train_data.num_data > 2_000_000):
+                # the fused kernels still take (N,1)/(N,3) operands,
+                # which pay TPU's 128-lane tile padding (~0.5 GB per
+                # million rows); the non-fused kernels got the compact
+                # layouts after the 10.5M-row OOM (pallas_wave.py)
+                Log.warning("tpu_histogram_mode=%s at %d rows: the fused "
+                            "kernels' per-row operands pay 128x lane "
+                            "padding in HBM and may OOM above ~4M rows; "
+                            "pallas_t (the auto choice) has compact "
+                            "layouts", hist_mode, train_data.num_data)
         else:
             self.wave_lookup = "onehot"
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when

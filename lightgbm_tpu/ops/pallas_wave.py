@@ -48,23 +48,26 @@ def _tile_plan(n, fc, bp, row_tile):
     bsub = 1
     while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
         bsub *= 2
-    c = max(512, min(row_tile, ((1 << 24) // (bsub * fc * 4)) // 8 * 8))
+    # c is the LANES dim of the transposed kernels' blocks, so it must be
+    # a multiple of 128 (Pallas TPU block rule) unless it equals the
+    # whole (padded) array dim — the c = n fallthrough below, where the
+    # wrapper pads the array to exactly c
+    c = max(512, min(row_tile // 128 * 128,
+                     ((1 << 24) // (bsub * fc * 4)) // 128 * 128))
     c = min(c, max(n, 1))
     return bsub, c
 
 
-def _split_weights_from_match(match, w3):
-    """(Cg, K) 0/1 match x (Cg, 3) channels -> bf16 hi/lo weight pair.
+def _hi_lo(wmat):
+    """Exact bf16 hi/lo split of an f32 weight matrix (any orientation).
 
-    Exact hi/lo split by mantissa truncation — a bf16 round-trip would be
-    folded to identity under --xla_allow_excess_precision, silently
-    zeroing the residual term (observed on v5e).  The residual is scaled
-    by 2^8 (exact) into bf16 range; Mosaic's f32->bf16 cast TRUNCATES
-    (measured: biased sums ~100x above round-to-nearest theory), so it is
-    rounded manually in bit arithmetic first.
+    Mantissa truncation — a bf16 round-trip would be folded to identity
+    under --xla_allow_excess_precision, silently zeroing the residual
+    term (observed on v5e).  The residual is scaled by 2^8 (exact) into
+    bf16 range; Mosaic's f32->bf16 cast TRUNCATES (measured: biased sums
+    ~100x above round-to-nearest theory), so it is rounded manually in
+    bit arithmetic first.
     """
-    wmat = jnp.concatenate(
-        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
     wh_f32 = pltpu.bitcast(
         pltpu.bitcast(wmat, jnp.uint32) & jnp.uint32(0xFFFF0000),
         jnp.float32)
@@ -76,12 +79,28 @@ def _split_weights_from_match(match, w3):
     return wh, wl
 
 
-def _split_weights(lid_ref, w3_ref, cid_ref):
-    """Per-child masked weights in hi/lo bf16, from leaf-id match.
-    Shared by every kernel layout so the precision workarounds in
-    _split_weights_from_match cannot diverge."""
-    match = (lid_ref[:] == cid_ref[:]).astype(jnp.float32)   # (Cg, K)
-    return _split_weights_from_match(match, w3_ref[:])
+def _split_weights_from_match(match, w3):
+    """(Cg, K) 0/1 match x (Cg, 3) channels -> (Cg, 3K) bf16 hi/lo."""
+    wmat = jnp.concatenate(
+        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
+    return _hi_lo(wmat)
+
+
+def _split_weights_t(lid_ref, w3_ref, cid_ref):
+    """Per-child masked weights in the ROW-VECTOR orientation: (3K, Cg)
+    bf16 hi/lo from lid (1, Cg), w3 (3, Cg), cid (K, 1).
+
+    This orientation exists because any (N, small) operand pays TPU's
+    (8, 128) lane tiling: an (N, 1) leaf-id column materializes at 128x
+    its logical bytes (~5 GB at the 10.5M-row flagship shape — an
+    instant HBM OOM), while (1, N)/(3, N) row layouts pad only the
+    sublane dim (8x / 2.7x of their small logical size).  The
+    broadcasts below produce (K, Cg)/(3K, Cg) tiles directly, no
+    transposes anywhere."""
+    match = (cid_ref[:] == lid_ref[:]).astype(jnp.float32)   # (K, Cg)
+    wmat = jnp.concatenate(
+        [match * w3_ref[ch:ch + 1, :] for ch in range(3)], axis=0)
+    return _hi_lo(wmat)                                      # (3K, Cg)
 
 
 def _unpack4_t(xti, fc):
@@ -137,15 +156,15 @@ def _lookup_and_route(xint, lc, tbl_ref, *, fc, bundled):
     return jnp.where(active & (gl < 0.5), r[:, 6:7].astype(jnp.int32), lc)
 
 
-def _accum_hist(out_ref, xr, base, wh, wl, *, bp, fc, bsub, transposed):
+def _accum_hist(out_ref, xr, base, wh, wl, *, bp, fc, bsub, dims):
     """Shared one-hot-generate + MXU-contract accumulation loop.
 
     xr/base: the repeated bin matrix and bin-iota, (Cg, bsub*Fc) row-major
-    or (bsub*Fc, Cg) transposed;  wh/wl: (Cg, 3K) bf16 hi/lo weights.
+    or (bsub*Fc, Cg) transposed;  wh/wl: bf16 hi/lo weights, (Cg, 3K) or
+    (3K, Cg) — `dims` is the dot_general contraction pair matching the
+    operand orientations, always contracting Cg.
     Accumulates (bsub*Fc, 3K) f32 blocks into out_ref rows per sub-block.
     """
-    dims = ((((1,), (0,)), ((), ())) if transposed
-            else (((0,), (0,)), ((), ())))
     for s in range(bp // bsub):
         oh = jnp.where(xr == base + jnp.float32(s * bsub),
                        jnp.float32(1.0),
@@ -180,7 +199,7 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
     # child match + channel-major weights, built in VMEM — nothing
     # per-wave crosses HBM beyond X/leaf_id/w3 themselves
-    wh, wl = _split_weights(lid_ref, w3_ref, cid_ref)
+    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref)  # (3K, Cg)
 
     # bins [s*bsub, (s+1)*bsub) x all features, bin-major columns.
     # f32 select then downcast: the i1 result carries f32 (8,128)
@@ -189,7 +208,7 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
     lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
     base = (lane // fc).astype(jnp.float32)              # 0..bsub-1 pattern
     _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                transposed=False)
+                dims=(((0,), (1,)), ((), ())))           # both contract Cg
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
@@ -212,12 +231,18 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     bp = _bin_pad(num_bins)
     bsub, c = _tile_plan(n, fc, bp, row_tile)
     pad = (-n) % c
-    lid2 = leaf_id[:, None]
-    w3f = w3.astype(jnp.float32)
+    # ROW-VECTOR layouts for the per-row operands: leaf ids as (1, N)
+    # and weights as (3, N) keep TPU's (8, 128) tiling near-dense (8x /
+    # 2.7x sublane pad) — the former (N, 1)/(N, 3) columns paid 128x /
+    # 42.7x LANE padding (~5 GB each at 10.5M rows; the r03 flagship
+    # OOM).  Blocks (1, c)/(3, c) are legal because the first dim equals
+    # the whole array dim and c is 128-aligned (_tile_plan).
+    lid2 = (jnp.pad(leaf_id, (0, pad), constant_values=-2) if pad
+            else leaf_id)[None, :]                       # (1, N)
+    w3t = jnp.transpose(w3.astype(jnp.float32))          # (3, N)
     if pad:
         X = jnp.pad(X, ((0, pad), (0, 0)))
-        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
-        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
+        w3t = jnp.pad(w3t, ((0, 0), (0, pad)))
     nch = (n + pad) // c
 
     kernel = functools.partial(_wave_hist_kernel, bp=bp, fc=fc, k=k,
@@ -228,11 +253,11 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
         in_specs=[
             pl.BlockSpec((c, fdev), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, c), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 3), lambda i: (i, 0),
+            pl.BlockSpec((3, c), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0),
+            pl.BlockSpec((k, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
@@ -241,7 +266,7 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(X, lid2, w3f, child_id[None, :])
+    )(X, lid2, w3t, child_id[:, None])
     # (Bp*Fc, 3K) bin-major rows, channel-major cols -> (K, Fc, B, 3)
     h = flat.reshape(bp, fc, 3, k)[:num_bins]
     return jnp.transpose(h, (3, 1, 0, 2))
@@ -278,13 +303,13 @@ def _wave_hist_kernel_t(xt_ref, lid_ref, w3_ref, cid_ref, out_ref,
     xt = xi.astype(jnp.float32)                      # (Fc, Cg)
     cg = xt.shape[1]
 
-    wh, wl = _split_weights(lid_ref, w3_ref, cid_ref)    # (Cg, 3K) hi/lo
+    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref)  # (3K, Cg) hi/lo
 
     xr = pltpu.repeat(xt, bsub, axis=0)              # (bsub*Fc, Cg) tiled
     base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
             // fc).astype(jnp.float32)               # bin-within-subblock
     _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                transposed=True)
+                dims=(((1,), (1,)), ((), ())))       # A @ B^T — both Cg
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
@@ -300,12 +325,13 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
     bp = _bin_pad(num_bins)
     bsub, c = _tile_plan(n, fc, bp, row_tile)
     pad = (-n) % c
-    lid2 = leaf_id[:, None]
-    w3f = w3.astype(jnp.float32)
+    # row-vector operand layouts — see wave_histogram_pallas
+    lid2 = (jnp.pad(leaf_id, (0, pad), constant_values=-2) if pad
+            else leaf_id)[None, :]                       # (1, N)
+    w3t = jnp.transpose(w3.astype(jnp.float32))          # (3, N)
     if pad:
         X_t = jnp.pad(X_t, ((0, 0), (0, pad)))
-        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
-        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
+        w3t = jnp.pad(w3t, ((0, 0), (0, pad)))
     nch = (n + pad) // c
 
     kernel = functools.partial(_wave_hist_kernel_t, bp=bp, fc=fc, k=k,
@@ -316,11 +342,11 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
         in_specs=[
             pl.BlockSpec((fdev, c), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, c), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 3), lambda i: (i, 0),
+            pl.BlockSpec((3, c), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0),
+            pl.BlockSpec((k, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
@@ -329,7 +355,7 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(X_t, lid2, w3f, child_id[None, :])
+    )(X_t, lid2, w3t, child_id[:, None])
     h = flat.reshape(bp, fc, 3, k)[:num_bins]
     return jnp.transpose(h, (3, 1, 0, 2))
 
@@ -375,7 +401,7 @@ def _wave_fused_kernel(x_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
     lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
     base = (lane // fc).astype(jnp.float32)
     _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                transposed=False)
+                dims=(((0,), (0,)), ((), ())))
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
@@ -491,7 +517,7 @@ def _wave_fused_kernel_ft(x_ref, xt_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
     base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
             // fc).astype(jnp.float32)
     _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                transposed=True)
+                dims=(((1,), (0,)), ((), ())))
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "bundled",

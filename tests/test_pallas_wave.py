@@ -304,3 +304,17 @@ def test_mesh_precomputes_xt_for_transposed_kernels(monkeypatch):
     make_wave_core.cache_clear(); make_wave_jit.cache_clear()
     dp2 = DataParallelTreeLearner(cfg, td, mesh)
     assert dp2._Xt is None
+
+
+def test_tile_plan_block_legality():
+    """Pallas TPU block rule: the row-tile c (the transposed kernels'
+    LANES dim) must be a multiple of 128 unless it equals the padded
+    array dim (c == n fallthrough).  fc=2000 (epsilon's width) caught
+    the un-aligned 2096 tile on chip."""
+    from lightgbm_tpu.ops.pallas_wave import _tile_plan, _bin_pad
+    for fc in (28, 137, 968, 2000):
+        for n in (513, 8192, 999424, 2_270_000):
+            for row_tile in (1000, 8192):     # non-128-multiple too
+                bsub, c = _tile_plan(n, fc, _bin_pad(64), row_tile)
+                assert c % 128 == 0 or c == n, (fc, n, bsub, c)
+                assert _bin_pad(64) % bsub == 0

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Stage 2: after the suite drains, validate the compact-layout pallas_t
+# kernel on chip at 1M, then re-run the flagship bench (full stderr kept).
+cd /root/repo
+while pgrep -f "bench_suite.py" > /dev/null; do sleep 60; done
+echo "[chain2] suite done at $(date -u)" >> /tmp/chain_r03.log
+python tools/tpu_ab2.py 999424 --r03b > /tmp/ab2_r03b.out 2>&1
+echo "[chain2] ab rc=$? at $(date -u)" >> /tmp/chain_r03.log
+python bench.py > /tmp/bench_r03b.out 2> /tmp/bench_r03b.err
+echo "[chain2] bench rc=$? at $(date -u)" >> /tmp/chain_r03.log
+cat /tmp/bench_r03b.out >> /tmp/chain_r03.log

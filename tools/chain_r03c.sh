@@ -1,0 +1,10 @@
+#!/bin/bash
+# Stage 3: after stage-2's bench exits, validate the now-legal pallas_t
+# layouts + lookup combos at 1M, then re-run the headline-shape TPU arms.
+cd /root/repo
+while pgrep -f "chain_r03b.sh" > /dev/null; do sleep 60; done
+echo "[chain3] stage2 done at $(date -u)" >> /tmp/chain_r03.log
+python tools/tpu_ab2.py 999424 --r03b > /tmp/ab2_r03c.out 2>&1
+echo "[chain3] ab rc=$? at $(date -u)" >> /tmp/chain_r03.log
+python tools/bench_suite.py higgs higgs_w64 epsilon epsilon_p16 msltr expo_cat >> /tmp/chain_r03.log 2>&1
+echo "[chain3] suite rc=$? at $(date -u)" >> /tmp/chain_r03.log

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Stage 4: after stage 3 (A/B + suite re-run), final flagship bench with
+# the full round-3 configuration (compact-layout kernels + compact lookup).
+cd /root/repo
+while pgrep -f "chain_r03c.sh" > /dev/null; do sleep 60; done
+echo "[chain4] stage3 done at $(date -u)" >> /tmp/chain_r03.log
+python bench.py > /tmp/bench_r03d.out 2> /tmp/bench_r03d.err
+echo "[chain4] bench rc=$? at $(date -u)" >> /tmp/chain_r03.log
+cat /tmp/bench_r03d.out >> /tmp/chain_r03.log

@@ -148,12 +148,37 @@ FOLLOWUP = [
       "extra": {"tpu_growth": "exact"}}),
 ]
 
+R03B = [
+    # compact-layout kernels (flagship OOM fix) + lookup strategies
+    ("pallas_t W=32 compactlayout",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32}),
+    ("pallas_t W=32 lk=compact",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_wave_lookup": "compact"}}),
+    ("pallas_t W=32 lk=gather",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_wave_lookup": "gather"}}),
+    ("onehot   W=32 lk=compact",
+     {"kind": "dense", "n": 0, "mode": "onehot", "width": 32,
+      "extra": {"tpu_wave_lookup": "compact"}}),
+    ("pallas   W=32 compactlayout",
+     {"kind": "dense", "n": 0, "mode": "pallas", "width": 32}),
+]
+
 
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if args else 999_424
     if "--followup" in sys.argv:
         combos = [(name, dict(spec, n=n)) for name, spec in FOLLOWUP]
+        run_combos(combos, n)
+        return
+    if "--r03b" in sys.argv:
+        # compact-operand-layout validation (the r03 flagship OOM fix):
+        # Mosaic must accept the (nch,c)/(3,N) layouts and perf must hold
+        # vs the 6.60 it/s (N,1)-layout pallas_t number; plus the new
+        # partition-lookup strategies at the same shape
+        combos = [(name, dict(spec, n=n)) for name, spec in R03B]
         run_combos(combos, n)
         return
     combos = [
