@@ -70,6 +70,11 @@ NOTES = {
                       "InfiniteBoost, batched otherwise)",
     "tpu_wave_chunk": "row-chunk of the wave sweep (VMEM vs scan-overhead "
                       "tradeoff; minimum 256, smaller values clamp)",
+    "tpu_wave_lookup": "auto / onehot / compact / gather — the partition "
+                       "sweep's per-row split-table lookup; compact "
+                       "matches rows against only the W wave parents "
+                       "(bit-identical trees, ~L/W less lookup traffic). "
+                       "auto: compact on TPU, onehot elsewhere",
     "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t / "
                           "pallas_f / pallas_ft histogram kernels; auto = "
                           "pallas_t on TPU under the wave engine (f32, "
@@ -122,7 +127,7 @@ GROUPS = [
         "machine_list_file", "histogram_pool_size"]),
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
-        "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
+        "tpu_wave_lookup", "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
         "tpu_use_dp", "tpu_predict", "tpu_profile_dir"]),
 ]
 
