@@ -11,11 +11,13 @@ round-trips through HBM:
   grid = (F/F_BLK, N/ROW_CHUNK)          (row chunks iterate fastest)
   per cell: for f in feature block:
       oh  = (bins_iota == x[f, :])        (B, C) one-hot in VMEM
-      acc = oh (B, C) @ w (C, 3)          MXU contraction
+      acc = oh (B, C) @ w (3, C)^T        MXU contraction (A @ B^T)
       out[f] += acc                        revisiting accumulation over chunks
 
 Layouts are chosen for the TPU tiling rules (last dim % 128, second-to-last
-% 8): bins arrive transposed (F, N), weights as (N, 3) [g*m, h*m, m], the
+% 8): bins arrive transposed (F, N), weights as a (3, N) row-vector
+[g*m, h*m, m] (an (N, 3) column operand would pay the 128-lane tile
+padding — 42.7x its logical bytes; see pallas_wave.py), the
 histogram leaves as (F, B, 3) — exactly the layout the split scanner wants,
 no transposes anywhere.  The leaf mask and bagging/GOSS row multipliers are
 folded into `w` by the caller, so rows outside the target leaf contribute
@@ -62,7 +64,7 @@ def _tile_shape(num_bins: int):
 def _hist_kernel(x_ref, w_ref, out_ref, *, num_bins: int, f_blk: int):
     """One (feature-block, row-chunk) cell.
 
-    x_ref: (F_BLK, C) f32 bin ids; w_ref: (C, 3) f32 weights;
+    x_ref: (F_BLK, C) f32 bin ids; w_ref: (3, C) f32 row-vector weights;
     out_ref: (F_BLK, B, 3) f32 accumulated over the row-chunk grid axis.
 
     The whole block's one-hot is built as ONE (F_BLK*B, C) tile: row r
@@ -79,7 +81,7 @@ def _hist_kernel(x_ref, w_ref, out_ref, *, num_bins: int, f_blk: int):
     C = x_ref.shape[1]
     FB = f_blk * num_bins
     x = x_ref[:]                                       # (F_BLK, C) f32
-    w = w_ref[:]                                       # (C, 3)
+    w = w_ref[:]                                       # (3, C) row-vector
     # S[r, j] = 1 iff j == r // B  (compile-time constant tile)
     r_over_b = lax.broadcasted_iota(jnp.int32, (FB, f_blk), 0) // num_bins
     feat = lax.broadcasted_iota(jnp.int32, (FB, f_blk), 1)
@@ -88,7 +90,9 @@ def _hist_kernel(x_ref, w_ref, out_ref, *, num_bins: int, f_blk: int):
     b_of_r = (lax.broadcasted_iota(jnp.int32, (FB, C), 0)
               % num_bins).astype(jnp.float32)
     oh = (x_rep == b_of_r).astype(jnp.float32)         # (FB, C)
-    acc = jnp.dot(oh, w, preferred_element_type=jnp.float32)     # (FB, 3)
+    acc = lax.dot_general(                             # A @ B^T: both C
+        oh, w, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (FB, 3)
     out_ref[:] = out_ref[:] + acc.reshape(f_blk, num_bins, 3)
 
 
@@ -103,7 +107,7 @@ def _hist_pallas(xt, w, num_bins: int, interpret: bool):
         grid=grid,
         in_specs=[
             pl.BlockSpec((f_blk, row_chunk), lambda i, c: (i, c)),
-            pl.BlockSpec((row_chunk, 3), lambda i, c: (c, 0)),
+            pl.BlockSpec((3, row_chunk), lambda i, c: (0, c)),
         ],
         out_specs=pl.BlockSpec((f_blk, num_bins, 3), lambda i, c: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((f, num_bins, 3), jnp.float32),
@@ -133,11 +137,15 @@ def leaf_histogram_pallas(binned, grad, hess, leaf_id, leaf, row_mult,
     fpad = (-f) % f_blk
     xt = binned.astype(jnp.float32).T                   # (F, N); bins < 2^24
                                                         # so f32 compare exact
+    # weights as a (3, N) row-vector operand: an (N, 3) column layout
+    # would pay TPU's 128-lane tile padding (42.7x its logical bytes —
+    # the same class of HBM blowup fixed in pallas_wave.py)
+    wt = jnp.transpose(w)                               # (3, N)
     if npad:
         xt = jnp.pad(xt, ((0, 0), (0, npad)))
-        w = jnp.pad(w, ((0, npad), (0, 0)))             # zero weight rows
+        wt = jnp.pad(wt, ((0, 0), (0, npad)))           # zero weight rows
     if fpad:
         xt = jnp.pad(xt, ((0, fpad), (0, 0)))
 
-    out = _hist_pallas(xt, w, num_bins, interpret)
+    out = _hist_pallas(xt, wt, num_bins, interpret)
     return out[:f]                                      # (F, B, 3)
