@@ -620,6 +620,15 @@ class GBDT:
     # ------------------------------------------------- device bulk predict
     _DEVICE_PREDICT_MIN_ROWS = 100_000
 
+    @staticmethod
+    def _predict_chunk_rows(n_features: int, n_devices: int) -> int:
+        """Rows per device-predict chunk.  Host V (i32) + D (bool) cost
+        F*5 bytes/row; the cap keeps the encode buffers ~<=3 GB because
+        the one-deep pipeline holds TWO chunks resident on device."""
+        bytes_per_row = max(n_features, 1) * 5
+        return min(4_000_000 * max(n_devices, 1),
+                   max(65_536, 3_000_000_000 // bytes_per_row))
+
     def _device_bulk_predict(self, features, num_used, k):
         """Rank-encoded TPU bulk prediction (ops/predict.py): f64-exact
         routing as int compares, Kahan f32 accumulation.  Returns None
@@ -652,24 +661,35 @@ class GBDT:
             return None                # fewer columns than the model uses
         devices = jax.local_devices()   # per-process rows -> local mesh
         out = np.empty((features.shape[0], k), np.float64)
-        # host V (i32) + D (bool) cost F*5 bytes/row; cap the chunk so the
-        # encode buffers stay ~<=6 GB however many devices/features
-        bytes_per_row = max(features.shape[1], 1) * 5
-        chunk = min(4_000_000 * max(len(devices), 1),
-                    max(65_536, 6_000_000_000 // bytes_per_row))
-        for lo in range(0, features.shape[0], chunk):
-            part = features[lo:lo + chunk]
+        chunk = self._predict_chunk_rows(features.shape[1], len(devices))
+        def dispatch(part):
+            """Async: device call issued, nothing blocked on."""
             V, D = dev_predict.rank_encode(rp, part)
             if len(devices) > 1:
                 # rows shard over the device mesh; trees replicate —
                 # bit-identical to single-device (pure data parallel)
                 score, nrows = dev_predict.ranked_predict_sharded(
                     rp, V, D, k, devices=devices)
-                score = jax.device_get(score)[:nrows]
-            else:
-                score = jax.device_get(dev_predict.ranked_predict_device(
-                    rp.dev, jnp.asarray(V), jnp.asarray(D), k))
-            out[lo:lo + len(part)] = np.asarray(score, np.float64)
+                return score, nrows
+            return dev_predict.ranked_predict_device(
+                rp.dev, jnp.asarray(V), jnp.asarray(D), k), len(part)
+
+        def drain(pending):
+            plo, pscore, pnrows = pending
+            out[plo:plo + pnrows] = np.asarray(
+                jax.device_get(pscore)[:pnrows], np.float64)
+
+        # one-deep pipeline: encode chunk i+1 on the host while the
+        # device computes chunk i (jax dispatch is async; device_get is
+        # the only sync point)
+        pending = None
+        for lo in range(0, features.shape[0], chunk):
+            score, nrows = dispatch(features[lo:lo + chunk])
+            if pending is not None:
+                drain(pending)
+            pending = (lo, score, nrows)
+        if pending is not None:
+            drain(pending)
         return out
 
     def predict(self, features: np.ndarray,

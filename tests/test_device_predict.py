@@ -174,3 +174,31 @@ def test_sharded_predict_through_booster(monkeypatch):
     g.config = g.config.copy_with(tpu_predict="false")
     p_host = bst.predict(X)
     np.testing.assert_allclose(p_dev, p_host, rtol=2e-6, atol=2e-6)
+
+
+def test_chunked_pipeline_predict_matches(monkeypatch):
+    """The one-deep chunk pipeline assembles multi-chunk predictions in
+    the right slots (chunk forced tiny so several chunks flow through a
+    single predict call)."""
+    from lightgbm_tpu.models import gbdt as gbdt_mod
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(1500, 5))
+    y = X[:, 0] - 0.4 * X[:, 2] + 0.05 * rng.normal(size=1500)
+    bst = _train(X, y, {"objective": "regression"})
+    g = bst._gbdt
+    g.config = g.config.copy_with(tpu_predict="false")
+    host = bst.predict(X)
+    calls = {"n": 0}
+    real_encode = dev_predict.rank_encode
+
+    def spy(rp, part):
+        calls["n"] += 1
+        return real_encode(rp, part)
+    monkeypatch.setattr(dev_predict, "rank_encode", spy)
+    monkeypatch.setattr(gbdt_mod.GBDT, "_predict_chunk_rows",
+                        staticmethod(lambda nf, nd: 400))
+    g.config = g.config.copy_with(tpu_predict="true")
+    g._ranked_pred_key = None
+    piped = bst.predict(X)
+    assert calls["n"] == 4, calls     # 1500 rows / 400-row chunks
+    np.testing.assert_allclose(piped, host, rtol=2e-6, atol=2e-6)
