@@ -148,6 +148,26 @@ FOLLOWUP = [
       "extra": {"tpu_growth": "exact"}}),
 ]
 
+R03E = [
+    # partition-scan chunk sizing: with the compact lookup the per-step
+    # temporaries are (C, W) not (C, L), so big chunks are VMEM-safe;
+    # at 10.5M the default 16384 makes 641 sequential scan steps/wave —
+    # likely loop-overhead-bound.  Measure the ladder at 1M (62 steps
+    # at 16k): if big chunks win here they win harder at the flagship.
+    ("pallas_t W=32 chunk=131072",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_wave_chunk": 131072}}),
+    ("pallas_t W=32 chunk=524288",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_wave_chunk": 524288}}),
+    ("pallas_t W=32 chunk=1048576",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_wave_chunk": 1048576}}),
+    ("onehot   W=32 chunk=131072",
+     {"kind": "dense", "n": 0, "mode": "onehot", "width": 32,
+      "extra": {"tpu_wave_chunk": 131072}}),
+]
+
 R03B = [
     # compact-layout kernels (flagship OOM fix) + lookup strategies
     ("pallas_t W=32 compactlayout",
@@ -171,6 +191,10 @@ def main():
     n = int(args[0]) if args else 999_424
     if "--followup" in sys.argv:
         combos = [(name, dict(spec, n=n)) for name, spec in FOLLOWUP]
+        run_combos(combos, n)
+        return
+    if "--r03e" in sys.argv:
+        combos = [(name, dict(spec, n=n)) for name, spec in R03E]
         run_combos(combos, n)
         return
     if "--r03b" in sys.argv:
