@@ -190,8 +190,8 @@ class SerialTreeLearner:
         if hist_mode not in (("auto", "onehot", "scatter", "pallas")
                              + WAVE_ONLY_MODES):
             Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
-                      "scatter/pallas/pallas_t/pallas_f/pallas_ft)",
-                      hist_mode)
+                      "scatter/pallas/pallas_t/pallas_f/pallas_ft/"
+                      "pallas_ct)", hist_mode)
         self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
         ncols = (len(train_data.bundle.num_group_bins)
                  if train_data.bundle is not None
@@ -330,7 +330,8 @@ class SerialTreeLearner:
                                     else "onehot")
             else:
                 self.wave_lookup = lk
-            if lk != "auto" and (hist_mode in ("pallas_f", "pallas_ft")
+            if lk != "auto" and (hist_mode in ("pallas_f", "pallas_ft",
+                                               "pallas_ct")
                                  or sparse_on):
                 Log.warning("tpu_wave_lookup=%s has no effect under %s "
                             "(the fused kernels / sparse pass own their "

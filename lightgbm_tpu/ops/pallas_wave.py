@@ -582,3 +582,146 @@ def wave_partition_hist_pallas_ft(X, X_t, leaf_id, w3, child_id, tbl,
     )(X, X_t, lid2, w3f, child_id[None, :], tbl)
     h = flat.reshape(bp, fc, 3, k)[:num_bins]
     return newlid[:n, 0], jnp.transpose(h, (3, 1, 0, 2))
+
+
+# --------------------------------------------------------------------------
+# v5 'pallas_ct': FUSED partition + histogram, COMPACT table, pure
+# row-vector orientation.  Lessons from v3/v4 and the r03 OOM applied
+# together: every per-row operand is a row vector ((1, N) lid, (3, N)
+# w3 — no lane-padded columns), the split lookup contracts the COMPACT
+# (10, W) table against a (W, Cg) parent match (W/L of v3's (Cg, L)
+# one-hot), the routing algebra runs entirely on (1, Cg) rows derived
+# from the TRANSPOSED tile (colv comes from a masked sublane reduction
+# of Xt — no row-major X operand at all), and the histogram is the v2
+# MXU-native A @ B^T.  ONE read of Xt per wave, no XLA partition scan,
+# no transposes anywhere.
+# --------------------------------------------------------------------------
+
+def _wave_fused_kernel_ct(xt_ref, lid_ref, w3_ref, cid_ref, tblt_ref,
+                          psrc_ref, lid_out_ref, out_ref,
+                          *, bp, fc, k, bsub, packed, bundled):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xi = xt_ref[:].astype(jnp.int32)                 # (Fdev, Cg)
+    if packed:
+        xi = _unpack4_t(xi, fc)
+    xint = xi                                        # (Fc, Cg) int32
+    cg = xint.shape[1]
+
+    # ---- compact split lookup: (W, Cg) parent match, (10, W) table
+    lid_row = lid_ref[:]                             # (1, Cg)
+    match_p = (psrc_ref[:] == lid_row).astype(jnp.float32)   # (W, Cg)
+    r = jax.lax.dot_general(                         # (10, Cg)
+        tblt_ref[:], match_p, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)         # int entries exact
+
+    active = r[0:1, :] > 0.5                         # (1, Cg)
+    cj = r[1:2, :].astype(jnp.int32)
+    f_iota = jax.lax.broadcasted_iota(jnp.int32, (fc, cg), 0)
+    colv = jnp.sum(jnp.where(cj == f_iota, xint, 0), axis=0,
+                   keepdims=True)                    # (1, Cg) split-col bin
+    if bundled:
+        goff = r[7:8, :].astype(jnp.int32)
+        span = r[9:10, :].astype(jnp.int32)
+        in_range = (colv >= goff) & (colv < goff + span)
+        colv = jnp.where(in_range,
+                         colv - goff + r[8:9, :].astype(jnp.int32),
+                         r[4:5, :].astype(jnp.int32))
+    thr = r[2:3, :].astype(jnp.int32)
+    is_cat = r[3:4, :] > 0.5
+    # f32 0/1 carry for the decision (the i8->i1 trunci Mosaic fix)
+    one, zero = jnp.float32(1.0), jnp.float32(0.0)
+    gl = jnp.where(is_cat,
+                   jnp.where(colv == thr, one, zero),
+                   jnp.where(colv <= thr, one, zero))
+    gl = jnp.where(colv == r[4:5, :].astype(jnp.int32),
+                   jnp.where(r[5:6, :] > 0.5, one, zero), gl)
+    new_lid = jnp.where(active & (gl < 0.5),
+                        r[6:7, :].astype(jnp.int32), lid_row)  # (1, Cg)
+    lid_out_ref[:] = new_lid
+
+    # ---- histograms from the UPDATED ids (v2 layout: (3K, Cg) weights;
+    # the shared helper accepts any (1, Cg) row, not just a ref)
+    wh, wl = _split_weights_t(new_lid, w3_ref, cid_ref)        # (3K, Cg)
+
+    xt = xint.astype(jnp.float32)
+    xr = pltpu.repeat(xt, bsub, axis=0)              # (bsub*Fc, Cg)
+    base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
+            // fc).astype(jnp.float32)
+    _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
+                dims=(((1,), (1,)), ((), ())))
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
+                                             "row_tile", "interpret",
+                                             "logical_cols"))
+def wave_partition_hist_pallas_ct(X_t, leaf_id, w3, child_id, cols, psrc,
+                                  num_bins: int, bundled: bool = False,
+                                  row_tile: int = 8192,
+                                  interpret: bool = False,
+                                  logical_cols: int = 0):
+    """Fused wave step from the transposed matrix alone.
+
+    X_t: (F, N) bins (packed: (ceil(F/2), N) with logical_cols);
+    leaf_id: (N,) int32 pre-wave; w3: (N, 3) [g, h, mult];
+    child_id: (K,) target smaller-child leaves (-1 = inactive);
+    cols: (W, 10) compact split rows (ops/wave.py column layout);
+    psrc: (W,) parent leaf id per wave slot (-3 = inactive).
+    Returns (new_leaf_id (N,), (K, F, B, 3) child histograms).
+    """
+    fdev, n = X_t.shape
+    fc = logical_cols or fdev
+    k = child_id.shape[0]
+    bp = _bin_pad(num_bins)
+    bsub, c = _tile_plan(n, fc, bp, row_tile)
+    pad = (-n) % c
+    lid2 = (jnp.pad(leaf_id, (0, pad), constant_values=-2) if pad
+            else leaf_id)[None, :]                   # (1, N)
+    w3t = jnp.transpose(w3.astype(jnp.float32))      # (3, N)
+    if pad:
+        X_t = jnp.pad(X_t, ((0, 0), (0, pad)))
+        w3t = jnp.pad(w3t, ((0, 0), (0, pad)))
+    nch = (n + pad) // c
+    tblt = jnp.transpose(cols.astype(jnp.float32))   # (10, W)
+
+    kernel = functools.partial(_wave_fused_kernel_ct, bp=bp, fc=fc, k=k,
+                               bsub=bsub, packed=bool(logical_cols),
+                               bundled=bundled)
+    newlid, flat = pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[
+            pl.BlockSpec((fdev, c), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, c), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((10, cols.shape[0]), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cols.shape[0], 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, c), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n + pad), jnp.int32),
+            jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(X_t, lid2, w3t, child_id[:, None], tblt, psrc[:, None])
+    h = flat.reshape(bp, fc, 3, k)[:num_bins]
+    return newlid[0, :n], jnp.transpose(h, (3, 1, 0, 2))

@@ -323,7 +323,7 @@ class Config:
         # auto = device for >=100k-row batches on TPU, host otherwise.
         "tpu_predict": ("str", "auto"),
         # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' |
-        # 'pallas_f' | 'pallas_ft' — histogram kernel ('pallas' =
+        # 'pallas_f' | 'pallas_ft' | 'pallas_ct' — histogram kernel ('pallas' =
         # exact-engine per-leaf kernel, 'pallas_t' = wave kernel with
         # MXU-native transposed operands, 'pallas_f' = fused partition+
         # histogram wave kernel, 'pallas_ft' = fused AND transposed —

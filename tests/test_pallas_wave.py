@@ -1,7 +1,8 @@
 """Wave-histogram Pallas kernels vs the XLA oracle (interpret mode, CPU).
 
 Covers all operand layouts (v1 row-major, v2 transposed, v3 fused,
-v4 fused+transposed) and the 4-bit packed input path of each.
+v4 fused+transposed, v5 fused compact-table row-vector) and the 4-bit
+packed input path of each.
 """
 import numpy as np
 import jax.numpy as jnp
@@ -40,7 +41,8 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft",
+                                  "pallas_ct"])
 def test_pallas_wave_data_parallel_constructs(mode):
     """tree_learner=data + a wave-only pallas mode must reach the mesh
     wave branch (the base constructor's exact-engine fallback maps these
@@ -57,7 +59,8 @@ def test_pallas_wave_data_parallel_constructs(mode):
     assert bst.predict(X).shape == (1600,)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft",
+                                  "pallas_ct"])
 def test_pallas_wave_mode_plumbing(mode):
     """Wave-only pallas modes resolve to wave growth and train (falling
     back to the einsum path off-TPU); exact growth rejects them."""
@@ -100,7 +103,7 @@ def test_kernel_packed_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
-def _route_numpy(X, leaf_id, tbl):
+def _route_numpy(X, leaf_id, tbl, bundled=False):
     """Numpy replica of the wave partition routing (ops/wave.py)."""
     r = tbl[np.clip(leaf_id, 0, tbl.shape[0] - 1)]
     r = np.where((leaf_id >= 0)[:, None], r, 0.0)
@@ -108,6 +111,12 @@ def _route_numpy(X, leaf_id, tbl):
     cj = r[:, 1].astype(np.int32)
     colv = X[np.arange(len(X)), np.clip(cj, 0, X.shape[1] - 1)].astype(
         np.int32)
+    if bundled:
+        goff = r[:, 7].astype(np.int32)
+        span = r[:, 9].astype(np.int32)
+        in_range = (colv >= goff) & (colv < goff + span)
+        colv = np.where(in_range, colv - goff + r[:, 8].astype(np.int32),
+                        r[:, 4].astype(np.int32))
     thr = r[:, 2].astype(np.int32)
     cat = r[:, 3] > 0.5
     gl = np.where(cat, colv == thr, colv <= thr)
@@ -318,3 +327,73 @@ def test_tile_plan_block_legality():
                 bsub, c = _tile_plan(n, fc, _bin_pad(64), row_tile)
                 assert c % 128 == 0 or c == n, (fc, n, bsub, c)
                 assert _bin_pad(64) % bsub == 0
+
+
+def _compact_from_tbl(tbl, w):
+    """(cols (W,10), psrc (W,)) compact operands from a dense (L,10)
+    table — active rows scatter into slots, the rest get psrc=-3."""
+    act = [l for l in range(len(tbl)) if tbl[l, 0] > 0.5]
+    cols = np.zeros((w, 10), np.float32)
+    psrc = np.full(w, -3, np.int32)
+    for j, l in enumerate(act):
+        cols[j] = tbl[l]
+        psrc[j] = l
+    return cols, psrc
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_fused_compact_kernel_matches_oracle(packed):
+    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas_ct
+
+    X, leaf_id, w3, cid, b = _data(n=2500, f=9 if packed else 7,
+                                   b=15 if packed else 14, k=5, seed=9)
+    L = 16
+    rng = np.random.default_rng(10)
+    leaf_id = rng.integers(0, 8, size=len(X)).astype(np.int32)
+    tbl = np.zeros((L, 10), np.float32)
+    for leaf in (1, 3, 5):
+        tbl[leaf] = [1, rng.integers(0, X.shape[1]), rng.integers(0, b),
+                     0, 0, rng.integers(0, 2), 8 + leaf, 0, 0, 0]
+    cols, psrc = _compact_from_tbl(tbl, w=5)
+
+    want_lid = _route_numpy(X, leaf_id, tbl)
+    want_hist = np.array(wave_histogram_reference(
+        jnp.asarray(X), jnp.asarray(want_lid), jnp.asarray(w3),
+        jnp.asarray(cid), b))
+    want_hist[np.asarray(cid) < 0] = 0.0
+
+    if packed:
+        Xdev = pack4_host(X).T
+        lc = X.shape[1]
+    else:
+        Xdev, lc = X.T, 0
+    got_lid, got_hist = wave_partition_hist_pallas_ct(
+        jnp.asarray(Xdev), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), jnp.asarray(cols), jnp.asarray(psrc), b,
+        interpret=True, logical_cols=lc)
+    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
+    np.testing.assert_allclose(np.asarray(got_hist), want_hist,
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_fused_compact_kernel_bundled_remap():
+    """The ct kernel's bundled branch (group offset / bin adjust / span
+    remap) routes identically to the numpy oracle — nonzero goff/adj/span
+    rows exercised, including out-of-range -> default-bin redirect."""
+    from lightgbm_tpu.ops.pallas_wave import wave_partition_hist_pallas_ct
+
+    X, leaf_id, w3, cid, b = _data(n=2200, f=7, b=14, k=5, seed=15)
+    rng = np.random.default_rng(16)
+    leaf_id = rng.integers(0, 8, size=len(X)).astype(np.int32)
+    tbl = np.zeros((16, 10), np.float32)
+    # leaf 2: group column 3, bins [4, 4+6) remap to adj 1, default bin 2
+    tbl[2] = [1, 3, 5, 0, 2, 1, 10, 4, 1, 6]
+    # leaf 5: group column 1, bins [0, 5), adj 0, default-right
+    tbl[5] = [1, 1, 2, 0, 7, 0, 13, 0, 0, 5]
+    cols, psrc = _compact_from_tbl(tbl, w=5)
+    want_lid = _route_numpy(X, leaf_id, tbl, bundled=True)
+    got_lid, _ = wave_partition_hist_pallas_ct(
+        jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), jnp.asarray(cols), jnp.asarray(psrc), b,
+        bundled=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)

@@ -166,6 +166,13 @@ R03E = [
     ("onehot   W=32 chunk=131072",
      {"kind": "dense", "n": 0, "mode": "onehot", "width": 32,
       "extra": {"tpu_wave_chunk": 131072}}),
+    # v5 fused compact-table row-vector kernel: one read of Xt per wave,
+    # no XLA partition scan at all — the design the v3/v4 attempts
+    # groped toward, built on the r03 layout lessons
+    ("pallas_ct W=32",
+     {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32}),
+    ("pallas_ct W=64",
+     {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 64}),
 ]
 
 R03B = [
