@@ -69,6 +69,13 @@ SHAPES = {
         "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
         "tpu_histogram_mode": "pallas_t", "tpu_wave_width": 64},
         warmup=3, measured=10, timeout=2700),
+    # v5 fused kernel at the flagship shape (one Xt read per wave, no
+    # partition scan) — the candidate to beat pallas_t's auto default
+    "higgs_ct": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
+        warmup=3, measured=10, timeout=2700),
 }
 
 

@@ -1,8 +1,10 @@
 #!/bin/bash
 # Stage 5: after the final bench, measure the partition-scan chunk ladder
-# and (if a chunk wins big) a flagship bench arm at that chunk.
+# + the pallas_ct arms at 1M, then pallas_ct at the flagship shape.
 cd /root/repo
 while pgrep -f "chain_r03d.sh" > /dev/null; do sleep 60; done
 echo "[chain5] stage4 done at $(date -u)" >> /tmp/chain_r03.log
 python tools/tpu_ab2.py 999424 --r03e > /tmp/ab2_r03e.out 2>&1
 echo "[chain5] ab rc=$? at $(date -u)" >> /tmp/chain_r03.log
+python tools/bench_suite.py higgs_ct >> /tmp/chain_r03.log 2>&1
+echo "[chain5] higgs_ct rc=$? at $(date -u)" >> /tmp/chain_r03.log
