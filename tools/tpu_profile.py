@@ -1,0 +1,52 @@
+"""Capture a jax.profiler trace of the wave engine on the current backend.
+
+The flagship residue analysis (ROADMAP.md) needs a ranked breakdown of
+where the ~130 ms/wave that is not the histogram kernel goes; no trace
+has ever been captured on chip.  This tool trains the bench recipe and
+wraps the steady-state iterations in a profiler trace viewable in
+Perfetto / TensorBoard.
+
+Usage:  python tools/tpu_profile.py [n_rows] [outdir] [k=v ...]
+        # defaults: 1_000_000 /tmp/tpu_trace; k=v pairs override params
+        # e.g. python tools/tpu_profile.py 999424 /tmp/tr tpu_wave_chunk=131072
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    args = [a for a in sys.argv[1:] if "=" not in a]
+    overrides = dict(a.split("=", 1) for a in sys.argv[1:] if "=" in a)
+    n = int(args[0]) if args else 999_424
+    outdir = args[1] if len(args) > 1 else "/tmp/tpu_trace"
+
+    import jax
+    from tools.bench_modes import make_data
+    import lightgbm_tpu as lgb
+
+    X, y = make_data(n)
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
+              "metric": "auc", "tpu_growth": "wave", "tpu_wave_width": 32}
+    params.update(overrides)
+    bst = lgb.Booster(params=params,
+                      train_set=lgb.Dataset(X, label=y, params=params))
+    gbdt = bst._gbdt
+    for _ in range(3):                      # compile + warm
+        gbdt.train_one_iter(None, None, False)
+    jax.block_until_ready(gbdt._score_dev)
+
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            gbdt.train_one_iter(None, None, False)
+        jax.block_until_ready(gbdt._score_dev)
+    print("trace written to", outdir,
+          "- open the .trace.json.gz in Perfetto (ui.perfetto.dev) or "
+          "point TensorBoard's profile plugin at the directory")
+
+
+if __name__ == "__main__":
+    main()
