@@ -397,3 +397,44 @@ def test_fused_compact_kernel_bundled_remap():
         jnp.asarray(cid), jnp.asarray(cols), jnp.asarray(psrc), b,
         bundled=True, interpret=True)
     np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
+
+
+def test_kernel_hist_w_invariant_per_child():
+    """Per-child histogram sums are independent of the wave width K:
+    child c's (F, B, 3) block is bitwise identical whether the kernel
+    runs with K=1 or c embedded in a K=5 slot set — each child owns its
+    own output columns and tiles accumulate in the same order.  This is
+    the structural property behind exact-order waves keeping the W
+    ladder on TPU (tpu_wave_order=exact + pallas kernels)."""
+    from lightgbm_tpu.ops.pallas_wave import (wave_histogram_pallas_t,
+                                              wave_partition_hist_pallas_ct)
+    X, leaf_id, w3, cid, b = _data(n=3100, f=7, b=14, k=5, seed=21)
+    wide = np.asarray(wave_histogram_pallas_t(
+        jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), b, interpret=True))
+    for j, c in enumerate(cid):
+        if c < 0:
+            continue
+        solo = np.asarray(wave_histogram_pallas_t(
+            jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(np.array([c], np.int32)), b, interpret=True))
+        np.testing.assert_array_equal(solo[0], wide[j])
+
+    # same property for the fused kernel (empty split table: routing is
+    # the identity, so the hist half sees the same leaf ids)
+    cols = np.zeros((5, 10), np.float32)
+    psrc = np.full(5, -3, np.int32)
+    _, wide_ct = wave_partition_hist_pallas_ct(
+        jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), jnp.asarray(cols), jnp.asarray(psrc), b,
+        interpret=True)
+    wide_ct = np.asarray(wide_ct)
+    for j, c in enumerate(cid):
+        if c < 0:
+            continue
+        _, solo_ct = wave_partition_hist_pallas_ct(
+            jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(np.array([c], np.int32)),
+            jnp.asarray(cols[:1]), jnp.asarray(psrc[:1]), b,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(solo_ct)[0], wide_ct[j])
