@@ -171,6 +171,38 @@ def main():
         time.sleep(30)
 
 
+def flagship_params():
+    return {"objective": "binary", "num_leaves": 255, "max_bin": 63,
+            "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
+            "metric": "auc", "tpu_growth": "wave", "tpu_wave_width": 32}
+
+
+def cache_path(params):
+    import zlib
+    pkey = zlib.crc32(repr(sorted(params.items())).encode()) & 0xFFFFFFFF
+    return "/tmp/bench_higgs_%d_%d_%08x.bin" % (N_ROWS, N_FEATURES, pkey)
+
+
+def prepare_cache():
+    """Build + publish the binned dataset cache WITHOUT touching any
+    device backend — safe to run while the tunnel is wedged."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import lightgbm_tpu as lgb
+    params = flagship_params()
+    cache = cache_path(params)
+    if os.path.exists(cache):
+        print("cache already present:", cache)
+        return
+    X, y = make_data()
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    tmp = "%s.tmp.%d" % (cache, os.getpid())
+    ds.save_binary(tmp)
+    os.replace(tmp, cache)
+    print("cache written:", cache)
+
+
 def child():
     import jax
     if os.environ.get("JAX_PLATFORMS"):
@@ -179,16 +211,12 @@ def child():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import lightgbm_tpu as lgb
 
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
-              "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
-              "metric": "auc", "tpu_growth": "wave", "tpu_wave_width": 32}
+    params = flagship_params()
     # the one-core data gen + binning costs minutes per attempt; cache the
     # BINNED dataset (atomic publish) so tunnel-wedge retries skip it.
     # Any cache problem falls back to a fresh build — the cache must never
     # be able to kill the measurement.
-    import zlib
-    pkey = zlib.crc32(repr(sorted(params.items())).encode()) & 0xFFFFFFFF
-    cache = "/tmp/bench_higgs_%d_%d_%08x.bin" % (N_ROWS, N_FEATURES, pkey)
+    cache = cache_path(params)
     train_set = None
     if os.path.exists(cache):
         try:
@@ -248,5 +276,7 @@ def child():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--prepare-cache":
+        prepare_cache()
     else:
         main()
