@@ -173,6 +173,17 @@ R03E = [
      {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32}),
     ("pallas_ct W=64",
      {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 64}),
+    # Bosch DENSE under the wave engine — never measured (the r03 arms
+    # ran exact-growth onehot 4.44 s/iter and the sparse store; the
+    # same-host reference CPU does ~0.40 s/iter on this shape, so the
+    # wave engine's pass amortization is the remaining dense lever:
+    # 968-col VMEM block at W=32 is ~24 MB, inside the gate)
+    ("bosch1Mx968 dense wave32",
+     {"kind": "sparse", "n": 1_000_000, "width": 32, "timeout": 2700,
+      "extra": {"tpu_growth": "wave"}}),
+    ("bosch1Mx968 dense wave64",
+     {"kind": "sparse", "n": 1_000_000, "width": 64, "timeout": 2700,
+      "extra": {"tpu_growth": "wave"}}),
 ]
 
 R03B = [
