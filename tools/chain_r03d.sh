@@ -4,6 +4,6 @@
 cd /root/repo
 while pgrep -f "chain_r03c.sh" > /dev/null; do sleep 60; done
 echo "[chain4] stage3 done at $(date -u)" >> /tmp/chain_r03.log
-python bench.py > /tmp/bench_r03d.out 2> /tmp/bench_r03d.err
+BENCH_DEADLINE_S=14400 python bench.py > /tmp/bench_r03d.out 2> /tmp/bench_r03d.err
 echo "[chain4] bench rc=$? at $(date -u)" >> /tmp/chain_r03.log
 cat /tmp/bench_r03d.out >> /tmp/chain_r03.log
