@@ -142,3 +142,14 @@ def test_exact_order_bundled_matches_w1():
     ds = lgb.Dataset(X, label=y, params=dict(params))
     ds.construct()
     assert ds._handle.bundle is not None
+
+
+@pytest.mark.parametrize("lookup", ["compact", "gather"])
+def test_exact_order_with_lookup_modes(lookup):
+    """Exact-order commit/rollback composes with every partition-lookup
+    strategy: trees still equal tpu_wave_width=1 bit-for-bit."""
+    X, y = _data(7)
+    params = dict(BASE, objective="binary", tpu_wave_lookup=lookup)
+    m1 = _model_string(params, X, y, {"tpu_wave_width": 1})
+    mw = _model_string(params, X, y, {"tpu_wave_width": 8})
+    assert mw == m1
