@@ -63,6 +63,45 @@ kMaxTreeOutput = 100.0
 kMinScore = -np.inf
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a durable directory.
+
+    The flagship wave program costs ~200 s to compile cold; on a flaky
+    device tunnel that compile can eat most of a healthy window.  With
+    the persistent cache a retry (or the driver's round-end bench) reuses
+    the serialized executable and reaches its first timed iteration in
+    seconds.  Resolution order: explicit arg > LGBM_TPU_COMPILE_CACHE env
+    (set to "0" to disable) > /tmp/lgbm_tpu_xla_cache.  Must run before
+    the first compilation; safe no-op if the config knobs are missing.
+    Returns the directory in use, or None when disabled/unavailable.
+    """
+    import os
+
+    import jax
+
+    d = cache_dir or os.environ.get("LGBM_TPU_COMPILE_CACHE",
+                                    "/tmp/lgbm_tpu_xla_cache")
+    if not d or d == "0":
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache every program, however small/fast — bench retries reuse
+        # dozens of sub-programs (binning, predict, metrics), not just
+        # the big grow loop
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # bound the dir: every bench/test child writes here, so without
+        # LRU eviction /tmp would grow until it squeezed out the dataset
+        # caches the retry path depends on
+        jax.config.update("jax_compilation_cache_max_size", 4 << 30)
+    except Exception as e:  # unknown config name on an older jax, RO fs...
+        from .log import Log
+        Log.warning("persistent compilation cache unavailable (%s)", e)
+        return None
+    return d
+
+
 def probe_device(timeout: float = 90.0) -> str:
     """One tiny matmul in a SUBPROCESS; returns the backend name.
 

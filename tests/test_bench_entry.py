@@ -51,3 +51,38 @@ def test_bench_exits_cleanly_when_deadline_exhausted():
                        cwd=REPO, env=env)
     assert r.returncode == 2
     assert "deadline exhausted" in r.stderr
+
+
+def test_persistent_compilation_cache(tmp_path):
+    """enable_compilation_cache points JAX's persistent cache at a durable
+    dir (VERDICT r3 Missing #6: bench retries must skip the ~200 s
+    flagship compile).  A fresh jit must leave entries on disk."""
+    code = (
+        "import jax, sys\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from lightgbm_tpu.utils.common import enable_compilation_cache\n"
+        "d = enable_compilation_cache(sys.argv[1])\n"
+        "assert d == sys.argv[1], d\n"
+        "import jax.numpy as jnp\n"
+        "jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128)))"
+        ".block_until_ready()\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code, str(tmp_path)],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(list(tmp_path.iterdir())) > 0
+
+
+def test_compilation_cache_disabled_by_env():
+    code = (
+        "import os\n"
+        "os.environ['LGBM_TPU_COMPILE_CACHE'] = '0'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from lightgbm_tpu.utils.common import enable_compilation_cache\n"
+        "assert enable_compilation_cache() is None\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -30,6 +30,8 @@ def run(X, y, mode, wave_width=32, warmup=3, measured=10,
     additionally returns the trained GBDT for learner introspection."""
     import jax
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.common import enable_compilation_cache
+    enable_compilation_cache()   # wedge retries skip recompiles
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
               "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
               "metric": "auc", "tpu_growth": "wave",

@@ -276,14 +276,32 @@ def main():
     deadline = time.time() + float(os.environ.get("SUITE_DEADLINE_S",
                                                   6 * 3600))
     pending = list(names)
+    down_since = None      # one line per outage, not one per probe pass
+    timeouts = {n: 0 for n in names}   # per-shape give-up cap (as tpu_ab2)
     while pending and time.time() < deadline:
         name = pending.pop(0)
         backend = probe_with_retries()
-        if backend is None:
-            append("    %-10s: device unreachable; re-queued" % name)
+        # a transient CPU fallback mid-tunnel-recovery must NOT start a
+        # flagship-sized measurement on the host CPU (hours, and the
+        # number would be meaningless) — non-tpu counts as unreachable,
+        # but the log says which it was so outage durations stay honest
+        usable = backend == "tpu" or (backend is not None
+                                      and os.environ.get("SUITE_ALLOW_CPU"))
+        if not usable:
+            if down_since is None:
+                down_since = time.time()
+                reason = ("unreachable" if backend is None
+                          else "on non-tpu backend %r" % backend)
+                append("    (device %s; %d shape(s) queued, "
+                       "retrying until deadline)"
+                       % (reason, len(pending) + 1))
             pending.append(name)
             time.sleep(120)
             continue
+        if down_since is not None:
+            append("    (device back after %.0f min down)"
+                   % ((time.time() - down_since) / 60.0))
+            down_since = None
         t0 = time.time()
         try:
             r = subprocess.run(
@@ -301,9 +319,17 @@ def main():
                       res["mode"], res["growth"], res["order"], res["W"],
                       time.time() - t0))
         except subprocess.TimeoutExpired:
-            append("    %-10s: TIMEOUT after %ds (re-queued)"
-                   % (name, SHAPES[name]["timeout"]))
-            pending.append(name)
+            timeouts[name] += 1
+            if timeouts[name] >= 2:
+                # twice through the full per-shape timeout with a live
+                # probe in between = deterministic hang, not a wedge —
+                # give up so it can't starve the shapes behind it
+                append("    %-10s: TIMEOUT x%d after %ds each — giving up"
+                       % (name, timeouts[name], SHAPES[name]["timeout"]))
+            else:
+                append("    %-10s: TIMEOUT after %ds (re-queued)"
+                       % (name, SHAPES[name]["timeout"]))
+                pending.append(name)
         except Exception as e:
             append("    %-10s: FAILED (%s)" % (name, e))
     for name in pending:

@@ -27,7 +27,8 @@ COMBO_TIMEOUT = 1500          # s per measurement subprocess
 PROBE_TIMEOUT = 90
 PROBE_RETRIES = 3             # short burst per pass; the outer loop re-visits
 PROBE_GAP = 60
-DEADLINE_S = 6 * 3600         # keep grinding up to 6h for a tunnel window
+DEADLINE_S = float(os.environ.get("AB2_DEADLINE_S",
+                                  6 * 3600))   # grind for a tunnel window
 
 
 def child(spec):
@@ -261,6 +262,10 @@ def run_combos(combos, n):
                 still.append((name, spec))
                 continue
             backend = probe_with_retries()
+            # non-tpu = unreachable: a transient CPU fallback must not
+            # start an hours-long host-CPU measurement (see bench_suite)
+            if backend != "tpu" and not os.environ.get("AB2_ALLOW_CPU"):
+                backend = None
             if backend is None:
                 print("  device unreachable; will re-try %r next pass"
                       % name, flush=True)
