@@ -623,11 +623,12 @@ class GBDT:
     @staticmethod
     def _predict_chunk_rows(n_features: int, n_devices: int) -> int:
         """Rows per device-predict chunk.  Host V (i32) + D (bool) cost
-        F*5 bytes/row; the cap keeps the encode buffers ~<=3 GB because
-        the one-deep pipeline holds TWO chunks resident on device."""
+        F*5 bytes/row; the one-deep pipeline keeps TWO chunks resident,
+        so the per-chunk budget is 1.5 GB for a ~3 GB device peak
+        (ADVICE r3: the old 3 GB/chunk budget meant a ~6 GB peak)."""
         bytes_per_row = max(n_features, 1) * 5
         return min(4_000_000 * max(n_devices, 1),
-                   max(65_536, 3_000_000_000 // bytes_per_row))
+                   max(65_536, 1_500_000_000 // bytes_per_row))
 
     def _device_bulk_predict(self, features, num_used, k):
         """Rank-encoded TPU bulk prediction (ops/predict.py): f64-exact

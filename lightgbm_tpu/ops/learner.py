@@ -316,10 +316,13 @@ class SerialTreeLearner:
                                               self.wave_order)
                            if growth == "wave" else 1)
         lk = str(config.tpu_wave_lookup).strip().lower()
+        # validate unconditionally (like tpu_histogram_mode): a typo'd
+        # value must not be silently ignored just because growth resolved
+        # to exact (ADVICE r3); it is APPLIED only under wave growth
+        if lk not in ("auto", "onehot", "compact", "gather"):
+            Log.fatal("Unknown tpu_wave_lookup %s (expected auto/"
+                      "onehot/compact/gather)", config.tpu_wave_lookup)
         if growth == "wave":
-            if lk not in ("auto", "onehot", "compact", "gather"):
-                Log.fatal("Unknown tpu_wave_lookup %s (expected auto/"
-                          "onehot/compact/gather)", config.tpu_wave_lookup)
             # auto -> compact on TPU (measured on v5e at 1Mx28/255
             # leaves/W=32: 7.12 it/s vs onehot-lookup's 6.34 on the XLA
             # engine — the (C, L) leaf one-hot was ~L/W of pure traffic);
@@ -330,9 +333,15 @@ class SerialTreeLearner:
                                     else "onehot")
             else:
                 self.wave_lookup = lk
-            if lk != "auto" and (hist_mode in ("pallas_f", "pallas_ft",
-                                               "pallas_ct")
-                                 or sparse_on):
+            # the "no effect" warning must only fire when the fused
+            # kernel will ACTUALLY run — off-TPU those modes fall back
+            # to the XLA partition scan where the lookup does apply
+            # (ADVICE r3); the sparse pass owns its lookup everywhere
+            from .wave import pallas_wave_active
+            fused_runs = (hist_mode in ("pallas_f", "pallas_ft",
+                                        "pallas_ct")
+                          and pallas_wave_active(hist_mode, self.dtype))
+            if lk != "auto" and (fused_runs or sparse_on):
                 Log.warning("tpu_wave_lookup=%s has no effect under %s "
                             "(the fused kernels / sparse pass own their "
                             "own lookup)", lk,

@@ -140,3 +140,66 @@ def test_sklearn_custom_objective():
     reg.fit(X, y)
     pred = reg.predict(X, raw_score=True)
     assert ((pred - y) ** 2).mean() < 1.0
+
+
+def _per_row_early_stop_oracle(gbdt, X, instance, num_used, k):
+    """The reference's per-row loop (predictor.hpp:33-96), kept as the
+    oracle for the vectorized tree-major implementation."""
+    n = X.shape[0]
+    out = np.zeros((n, k), dtype=np.float64)
+    for r in range(n):
+        row = X[r:r + 1]
+        pred = np.zeros(k)
+        for t in range(num_used):
+            pred[t % k] += gbdt.models[t].predict(row)[0]
+            if (t + 1) % (instance.round_period * k) == 0 and \
+                    instance.callback(pred):
+                break
+        out[r] = pred
+    return out
+
+
+def test_predictor_early_stop_matches_per_row_oracle(tmp_path):
+    """The vectorized active-set loop must reproduce the per-row
+    semantics EXACTLY — every row stops at the same tree."""
+    bst, X, y = make_model(tmp_path, rounds=40)
+    pred = Predictor(bst._gbdt, raw_score=True, early_stop=True,
+                     early_stop_freq=3, early_stop_margin=0.8)
+    got = pred.predict(X)
+    k = bst._gbdt.num_tree_per_iteration
+    oracle = _per_row_early_stop_oracle(bst._gbdt, X, pred.early_stop,
+                                        bst._gbdt._used_trees(-1), k)
+    np.testing.assert_array_equal(got, oracle[:, 0])
+
+
+def test_predictor_early_stop_multiclass_matches_oracle():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 5))
+    y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(300, 3)), axis=1)
+    params = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+              "num_leaves": 7, "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y.astype(np.float64),
+                                        params=params), num_boost_round=30)
+    pred = Predictor(bst._gbdt, raw_score=True, early_stop=True,
+                     early_stop_freq=4, early_stop_margin=0.5)
+    got = pred.predict(X)
+    k = bst._gbdt.num_tree_per_iteration
+    oracle = _per_row_early_stop_oracle(bst._gbdt, X, pred.early_stop,
+                                        bst._gbdt._used_trees(-1), k)
+    np.testing.assert_array_equal(got, oracle)
+
+
+def test_predictor_early_stop_custom_scalar_instance(tmp_path):
+    """A custom instance without batch_callback rides the scalar
+    fallback and must agree with the oracle too."""
+    from lightgbm_tpu.predictor import PredictionEarlyStopInstance
+    bst, X, y = make_model(tmp_path, rounds=30)
+    pred = Predictor(bst._gbdt, raw_score=True, early_stop=True,
+                     early_stop_freq=5, early_stop_margin=1.0)
+    pred.early_stop = PredictionEarlyStopInstance(
+        lambda p: abs(p[0]) > 0.6, 5)        # scalar-only
+    got = pred.predict(X)
+    k = bst._gbdt.num_tree_per_iteration
+    oracle = _per_row_early_stop_oracle(bst._gbdt, X, pred.early_stop,
+                                        bst._gbdt._used_trees(-1), k)
+    np.testing.assert_array_equal(got, oracle[:, 0])

@@ -270,3 +270,60 @@ def test_wave_lookup_validation():
     from lightgbm_tpu.utils.log import LightGBMError
     with pytest.raises(LightGBMError):
         lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)
+
+
+def test_wave_auto_width_quality_envelope():
+    """Every width the auto ladder can resolve to (8/16/32 at its
+    num_leaves breakpoints) trains within epsilon of the exact W=1 order
+    on a fixed dataset.  Pins VERDICT r3 Weak #4: W=128 measurably
+    degrades AUC (0.9319 vs 0.9362 at the 1M on-chip A/B,
+    tools/AB_RESULTS.md 11:30 block); the ladder caps at 32 to stay off
+    that cliff, and a future ladder change that ships a quality-losing
+    width must fail here."""
+    from lightgbm_tpu.ops.learner import resolve_wave_width
+    from lightgbm_tpu.utils.config import Config
+
+    # the ladder must never resolve past the measured-safe 32
+    for leaves in (31, 127, 255, 1023, 4095):
+        w = resolve_wave_width(Config({"verbose": -1,
+                                       "objective": "binary"}), leaves)
+        assert w <= 32, "auto ladder shipped W=%d at %d leaves" % (w,
+                                                                   leaves)
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(20000, 10))
+    wvec = rng.normal(size=10)
+    y = ((X @ wvec + 0.5 * rng.normal(size=20000)) > 0).astype(np.float64)
+
+    def auc_of(params, rounds=12):
+        ds = lgb.Dataset(X, label=y, params=params)
+        p = lgb.train(params, ds, num_boost_round=rounds).predict(X)
+        order = np.argsort(p)
+        ranks = np.empty(len(p)); ranks[order] = np.arange(1, len(p) + 1)
+        npos = y.sum(); nneg = len(y) - npos
+        return (ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+    # one (num_leaves -> auto width) point per ladder rung
+    for leaves, expect_w in ((31, 8), (127, 16), (255, 32)):
+        base = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+                "learning_rate": 0.2, "min_data_in_leaf": 5, "verbose": -1}
+        cfg = Config(dict(base, tpu_growth="wave", tpu_wave_width=-1))
+        assert resolve_wave_width(cfg, leaves) == expect_w
+        auc_exact = auc_of(dict(base, tpu_growth="exact"))
+        auc_wave = auc_of(dict(base, tpu_growth="wave", tpu_wave_width=-1))
+        assert auc_wave > auc_exact - 5e-3, \
+            "auto W=%d at %d leaves lost %.2e AUC vs exact" % (
+                expect_w, leaves, auc_exact - auc_wave)
+
+
+def test_wave_lookup_validated_under_exact_growth_too():
+    """A typo'd tpu_wave_lookup must be fatal even when growth resolves
+    to exact (where the value is never applied) — like
+    tpu_histogram_mode, validation is unconditional (ADVICE r3)."""
+    p = {"objective": "binary", "verbose": -1, "tpu_growth": "exact",
+         "tpu_wave_lookup": "bogus"}
+    X = np.random.default_rng(0).normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)
