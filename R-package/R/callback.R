@@ -1,0 +1,78 @@
+# Callback constructors — parity with the reference R-package/R/callback.R
+# surface (cb.reset.parameters / cb.print.evaluation / cb.record.evaluation /
+# cb.early.stop).
+#
+# The reference builds an R-side callback engine (R6 CB_ENV, add.cb,
+# categorize.callbacks) because its training loop lives in R.  Here the
+# loop lives in the Python engine (engine.py), which already runs
+# callback objects with before/after-iteration phases — so each R
+# constructor returns the corresponding Python callback, and lgb.train /
+# lgb.cv forward them through the `callbacks` argument.  One loop, one
+# behavior, both languages.
+
+#' Reset parameters on a schedule
+#'
+#' @param new_params named list; each element is either a vector with one
+#'   value per boosting round or a function(iter, nrounds) -> value
+#'   (0-based iter, as the reference documents)
+#' @return a callback for the callbacks argument of lgb.train / lgb.cv
+#' @export
+cb.reset.parameters <- function(new_params) {
+  lgb <- .lgb_py()
+  stopifnot(is.list(new_params), length(names(new_params)) ==
+              length(new_params))
+  py_args <- lapply(new_params, function(v) {
+    if (is.function(v)) .as_py_schedule(v) else as.list(v)
+  })
+  do.call(lgb$reset_parameter, py_args)
+}
+
+# A plain reticulate wrapper has the Python signature (*args, **kwargs),
+# so the engine cannot see whether an R schedule is function(iter) or
+# function(iter, nrounds).  Tag the wrapper with the explicit arity
+# marker the Python side honors (callback.py _schedule_arity); the
+# 2-arg form additionally goes through py_func so the call crosses with
+# both positional arguments.
+.as_py_schedule <- function(v) {
+  arity <- length(formals(v))
+  pyf <- tryCatch(reticulate::py_func(v), error = function(e) {
+    reticulate::r_to_py(v)
+  })
+  tryCatch(reticulate::py_set_attr(pyf, "lgb_schedule_arity",
+                                   if (arity >= 2L) 2L else 1L),
+           error = function(e) NULL)
+  pyf
+}
+
+#' Print evaluation results every `period` iterations
+#'
+#' @param period print frequency
+#' @param show_stdv show fold stdv (cv records)
+#' @export
+cb.print.evaluation <- function(period = 1L, show_stdv = TRUE) {
+  .lgb_py()$print_evaluation(as.integer(period), show_stdv)
+}
+
+#' Record evaluation results
+#'
+#' The recorded history is attached to the returned callback as
+#' attr(cb, "eval_result") (a reticulate dict; read it after training
+#' with reticulate::py_to_r).  lgb.train already records into
+#' attr(bst, "record_evals") by default — this constructor exists for
+#' explicit reference-style pipelines.
+#' @export
+cb.record.evaluation <- function() {
+  store <- reticulate::dict()
+  cb <- .lgb_py()$record_evaluation(store)
+  attr(cb, "eval_result") <- store
+  cb
+}
+
+#' Early stopping on validation metrics
+#'
+#' @param stopping_rounds stop when no metric improves this many rounds
+#' @param verbose print the early-stop decision
+#' @export
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  .lgb_py()$early_stopping(as.integer(stopping_rounds), verbose)
+}

@@ -14,7 +14,7 @@
 lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
                    label = NULL, stratified = TRUE, folds = NULL,
                    early_stopping_rounds = NULL, eval = NULL,
-                   verbose = 1L, seed = 0L, ...) {
+                   verbose = 1L, seed = 0L, callbacks = list(), ...) {
   if (!lgb.is.Dataset(data)) stop("lgb.cv: data must be an lgb.Dataset")
   lgb <- .lgb_py()
   if (!is.null(label)) setinfo(data, "label", label)
@@ -35,6 +35,7 @@ lgb.cv <- function(params = list(), data, nrounds = 10L, nfold = 5L,
                 nfold = as.integer(nfold), stratified = stratified,
                 folds = py_folds, metrics = eval,
                 early_stopping_rounds = .as_int_or_null(early_stopping_rounds),
+                callbacks = if (length(callbacks)) unname(callbacks) else NULL,
                 verbose_eval = verbose > 0L, seed = as.integer(seed))
   rec <- reticulate::py_to_r(out)
   structure(list(record_evals = rec,

@@ -17,7 +17,8 @@
 lgb.train <- function(params = list(), data, nrounds = 10L,
                       valids = list(), early_stopping_rounds = NULL,
                       init_model = NULL, verbose = 1L, eval_freq = 1L,
-                      categorical_feature = NULL, colnames = NULL, ...) {
+                      categorical_feature = NULL, colnames = NULL,
+                      callbacks = list(), ...) {
   if (!lgb.is.Dataset(data)) stop("lgb.train: data must be an lgb.Dataset")
   lgb <- .lgb_py()
   if (!is.null(categorical_feature)) {
@@ -34,6 +35,7 @@ lgb.train <- function(params = list(), data, nrounds = 10L,
     early_stopping_rounds = .as_int_or_null(early_stopping_rounds),
     init_model = init_model,
     evals_result = evals,
+    callbacks = if (length(callbacks)) unname(callbacks) else NULL,
     verbose_eval = if (verbose > 0L) as.integer(eval_freq) else FALSE)
   bst <- .lgb_tag_booster(bst)
   attr(bst, "record_evals") <- reticulate::py_to_r(evals)

@@ -10,7 +10,7 @@ for (f in c("utils.R", "lgb.Dataset.R", "lgb.Booster.R", "lgb.train.R",
             "lgb.cv.R", "lightgbm.R", "lgb.importance.R",
             "lgb.model.dt.tree.R", "lgb.interprete.R",
             "lgb.plot.importance.R", "lgb.plot.interpretation.R",
-            "lgb.prepare.R", "saveRDS.lgb.Booster.R")) {
+            "lgb.prepare.R", "saveRDS.lgb.Booster.R", "callback.R")) {
   source(file.path(r_dir, f))
 }
 
@@ -78,6 +78,25 @@ cv <- lgb.cv(params = list(objective = "binary", num_leaves = 7,
              stratified = FALSE, verbose = 0L)
 stopifnot(inherits(cv, "lgb.CVBooster"),
           length(cv$record_evals[["binary_logloss-mean"]]) == 8L)
+
+# ---- callbacks: LR schedule + explicit record + early stop
+rec_cb <- cb.record.evaluation()
+bst5 <- lgb.train(params = list(objective = "binary", num_leaves = 7,
+                                metric = "binary_logloss", verbose = -1),
+                  data = dtrain, nrounds = 12L,
+                  valids = list(valid_0 = dvalid), verbose = 0L,
+                  callbacks = list(
+                    cb.reset.parameters(list(
+                      learning_rate = function(iter, n) 0.3 * 0.95^iter)),
+                    rec_cb))
+rec <- reticulate::py_to_r(attr(rec_cb, "eval_result"))
+stopifnot(length(rec$valid_0$binary_logloss) == 12L)
+bst6 <- lgb.train(params = list(objective = "binary", num_leaves = 7,
+                                metric = "binary_logloss", verbose = -1),
+                  data = dtrain, nrounds = 200L,
+                  valids = list(valid_0 = dvalid), verbose = 0L,
+                  callbacks = list(cb.early.stop(5L, verbose = FALSE)))
+stopifnot(attr(bst6, "best_iter") < 200L)
 
 # ---- lightgbm() convenience + prepare
 df <- data.frame(a = rnorm(50), b = factor(sample(c("x", "y", "z"), 50,

@@ -53,24 +53,61 @@ def record_evaluation(eval_result: dict):
     eval_result.clear()
 
     def init(env: CallbackEnv):
-        for data_name, eval_name, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
+        # items are 4-tuples from train and 5-tuples (with stdv) from cv
+        for item in env.evaluation_result_list:
+            eval_result.setdefault(item[0], collections.OrderedDict())
+            eval_result[item[0]].setdefault(item[1], [])
 
     def callback(env: CallbackEnv):
         if not eval_result:
             init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result[data_name][eval_name].append(result)
+        for item in env.evaluation_result_list:
+            eval_result[item[0]][item[1]].append(item[2])
     callback.order = 20
     return callback
+
+
+def _schedule_arity(fn) -> int:
+    """1 or 2: how many positional args a reset_parameter schedule takes.
+
+    Only REQUIRED positional parameters count — a default (lambda i,
+    base=0.3: ...) or **kwargs must not flip a 1-arg schedule into the
+    2-arg calling convention.  An explicit ``lgb_schedule_arity``
+    attribute wins (the R bridge sets it on reticulate wrappers, whose
+    Python signatures are otherwise (*args, **kwargs)); other
+    unintrospectable callables default to 1, the python-surface
+    convention.
+    """
+    import inspect
+    marked = getattr(fn, "lgb_schedule_arity", None)
+    try:
+        if marked is not None and int(marked) in (1, 2):
+            return int(marked)
+    except (TypeError, ValueError):
+        pass
+    try:
+        params = inspect.signature(fn).parameters.values()
+    except (TypeError, ValueError):
+        return 1
+    required = sum(
+        1 for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        and p.default is p.empty)
+    return 2 if required >= 2 else 1
 
 
 def reset_parameter(**kwargs):
     """Per-iteration parameter schedule (callback.py reset_parameter):
     delegates to Booster.reset_parameter, which rebuilds the running
     learner config in place — num_leaves, lambdas, bagging, etc. all take
-    effect, with a fast path for learning_rate."""
+    effect, with a fast path for learning_rate.
+
+    Schedules may be lists (one value per round), f(iteration), or —
+    matching the reference R package's cb.reset.parameters contract —
+    f(iteration, num_boost_round); arity is resolved once here."""
+    arity = {k: _schedule_arity(v) for k, v in kwargs.items()
+             if callable(v) and not isinstance(v, list)}
+
     def callback(env: CallbackEnv):
         new_parameters = {}
         for key, value in kwargs.items():
@@ -79,7 +116,13 @@ def reset_parameter(**kwargs):
                     raise ValueError("Length of list %s has to equal to 'num_boost_round'." % key)
                 new_param = value[env.iteration - env.begin_iteration]
             elif callable(value):
-                new_param = value(env.iteration - env.begin_iteration)
+                it = env.iteration - env.begin_iteration
+                if arity[key] >= 2:
+                    new_param = value(it,
+                                      env.end_iteration -
+                                      env.begin_iteration)
+                else:
+                    new_param = value(it)
             else:
                 raise ValueError("Only list and callable values are supported "
                                  "as a mapping from boosting round index to new parameter value.")

@@ -12,7 +12,7 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset, _InnerPredictor
 from .utils.config import key_alias_transform
-from .utils.log import LightGBMError, Log
+from .utils.log import LightGBMError
 
 __all__ = ["train", "cv"]
 
@@ -83,21 +83,12 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     # callbacks
     cbs = set(callbacks or [])
-    if verbose_eval is True:
-        cbs.add(callback_mod.print_evaluation())
-    elif isinstance(verbose_eval, int) and verbose_eval:
-        cbs.add(callback_mod.print_evaluation(verbose_eval))
-    if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
-                                            verbose=bool(verbose_eval)))
     if learning_rates is not None:
         cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         cbs.add(callback_mod.record_evaluation(evals_result))
-    cbs_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
-    cbs_after = cbs - cbs_before
-    cbs_before = sorted(cbs_before, key=lambda cb: getattr(cb, "order", 0))
-    cbs_after = sorted(cbs_after, key=lambda cb: getattr(cb, "order", 0))
+    cbs_before, cbs_after = _assemble_callbacks(cbs, verbose_eval,
+                                                early_stopping_rounds)
 
     booster.best_iteration = -1
     finished_iteration = num_boost_round
@@ -200,6 +191,27 @@ class CVBooster:
         return handler
 
 
+def _assemble_callbacks(cbs, verbose_eval, early_stopping_rounds,
+                        show_stdv: bool = True):
+    """One callback-engine assembly for train() AND cv(): implicit
+    print/early-stop injection from the legacy kwargs, then the
+    before/after-iteration split in `order` order."""
+    cbs = set(cbs)
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval,
+                                                          bool) \
+            and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            verbose=bool(verbose_eval)))
+    cbs_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    return (sorted(cbs_before, key=lambda cb: getattr(cb, "order", 0)),
+            sorted(cbs_after, key=lambda cb: getattr(cb, "order", 0)))
+
+
 def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
        stratified: bool = False, shuffle: bool = True, metrics=None, fobj=None,
        feval=None, init_model=None, feature_name="auto",
@@ -225,8 +237,21 @@ def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
         bst.add_valid(valid_sub, "valid")
         cvbooster.append(bst)
 
-    best_iter = num_boost_round
+    # callbacks drive the fold loop exactly as they drive train()'s:
+    # env.model is the CVBooster, whose __getattr__ broadcasts
+    # update/reset_parameter to every fold (reference engine.py:398-425);
+    # cv aggregates cross as 5-tuples ("cv_agg", name, mean, hb, stdv)
+    cbs_before, cbs_after = _assemble_callbacks(callbacks or [],
+                                                verbose_eval,
+                                                early_stopping_rounds,
+                                                show_stdv)
+
     for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(model=cvbooster, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=None))
         agg = collections.defaultdict(list)
         # broadcast through CVBooster.__getattr__, as the reference's cv
         # drives its folds (engine.py:398-401)
@@ -234,26 +259,20 @@ def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
         for fold_evals in cvbooster.eval_valid(feval):
             for (_, name, score, hb) in fold_evals:
                 agg[(name, hb)].append(score)
-        one_result = {}
+        res = []
         for (name, hb), scores in agg.items():
-            results[name + "-mean"].append(float(np.mean(scores)))
-            results[name + "-stdv"].append(float(np.std(scores)))
-            one_result[name] = (float(np.mean(scores)), hb)
-        if verbose_eval:
-            msg = "\t".join("cv_agg %s: %g + %g" % (n.rsplit("-", 1)[0], m, s)
-                            for (n, m), s in zip(
-                                [(k, v[-1]) for k, v in results.items() if k.endswith("mean")],
-                                [v[-1] for k, v in results.items() if k.endswith("stdv")]))
-            Log.info("[%d]\t%s", i + 1, msg)
-        if early_stopping_rounds is not None and early_stopping_rounds > 0:
-            # stop if the first metric hasn't improved for the window
-            key = next(iter(results))
-            vals = results[key]
-            hb = next(iter(agg))[1] if agg else False
-            best_idx = int(np.argmax(vals) if hb else np.argmin(vals))
-            if i - best_idx >= early_stopping_rounds:
-                best_iter = best_idx + 1
-                for k in list(results.keys()):
-                    results[k] = results[k][:best_idx + 1]
-                break
+            mean, stdv = float(np.mean(scores)), float(np.std(scores))
+            results[name + "-mean"].append(mean)
+            results[name + "-stdv"].append(stdv)
+            res.append(("cv_agg", name, mean, hb, stdv))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(model=cvbooster, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=res))
+        except callback_mod.EarlyStopException as e:
+            for k in list(results.keys()):
+                results[k] = results[k][:e.best_iteration + 1]
+            break
     return dict(results)

@@ -349,3 +349,94 @@ def test_exact_growth_ignores_bad_wave_width():
                     lgb.Dataset(X, label=y), num_boost_round=3,
                     verbose_eval=False)
     assert bst.current_iteration() == 3
+
+
+def test_cv_runs_callbacks():
+    """cv() must actually drive the callback engine (reset schedules,
+    record, early stop) over the fold boosters — R's lgb.cv forwards
+    callbacks here, so a silent no-op would strand that surface."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(600, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "metric": "binary_logloss"}
+
+    seen = []
+    store = {}
+
+    def spy(env):
+        seen.append((env.iteration,
+                     [i[:3] for i in env.evaluation_result_list]))
+    spy.order = 25
+
+    out = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=4,
+                 nfold=3, stratified=False, verbose_eval=False,
+                 callbacks=[spy, lgb.record_evaluation(store),
+                            lgb.reset_parameter(
+                                learning_rate=lambda i, n: 0.3 * 0.9 ** i)])
+    assert len(out["binary_logloss-mean"]) == 4
+    assert [s[0] for s in seen] == [0, 1, 2, 3]
+    # 5-tuple cv_agg entries reached the callbacks with the mean score
+    assert seen[0][1][0][0] == "cv_agg"
+    assert store["cv_agg"]["binary_logloss"] == out["binary_logloss-mean"]
+
+    # early stopping via the callback engine truncates the records
+    out2 = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=300,
+                  nfold=3, stratified=False, verbose_eval=False,
+                  callbacks=[lgb.early_stopping(5, False)])
+    assert len(out2["binary_logloss-mean"]) < 300
+
+
+def test_reset_parameter_schedule_arities():
+    """f(iter), f(iter, nrounds), and f(iter, base=default) must all be
+    called correctly — a defaulted second arg is NOT the 2-arg form."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    calls = {"one": [], "two": [], "defaulted": []}
+
+    def one(i):
+        calls["one"].append(i)
+        return 0.1
+
+    def two(i, n):
+        calls["two"].append((i, n))
+        return 0.1
+
+    def defaulted(i, base=0.2):
+        calls["defaulted"].append((i, base))
+        return base
+
+    for fn in (one, two, defaulted):
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3,
+                  callbacks=[lgb.reset_parameter(learning_rate=fn)],
+                  verbose_eval=False)
+    assert calls["one"] == [0, 1, 2]
+    assert calls["two"] == [(0, 3), (1, 3), (2, 3)]
+    # the default survived: nrounds was NOT substituted for base
+    assert calls["defaulted"] == [(0, 0.2), (1, 0.2), (2, 0.2)]
+
+
+def test_reset_parameter_honors_arity_marker():
+    """The R bridge tags reticulate wrappers (Python signature
+    (*args, **kwargs)) with lgb_schedule_arity; the marker must win
+    over signature inspection."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    calls = []
+
+    def wrapperish(*args, **kwargs):       # uninformative signature
+        calls.append(args)
+        return 0.1
+    wrapperish.lgb_schedule_arity = 2
+
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+              lgb.Dataset(X, label=y), num_boost_round=2,
+              callbacks=[lgb.reset_parameter(learning_rate=wrapperish)],
+              verbose_eval=False)
+    assert calls == [(0, 2), (1, 2)]
