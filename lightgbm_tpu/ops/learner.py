@@ -190,8 +190,7 @@ class SerialTreeLearner:
         if hist_mode not in (("auto", "onehot", "scatter", "pallas")
                              + WAVE_ONLY_MODES):
             Log.fatal("Unknown tpu_histogram_mode %s (expected auto/onehot/"
-                      "scatter/pallas/pallas_t/pallas_f/pallas_ft/"
-                      "pallas_ct)", hist_mode)
+                      "scatter/pallas/pallas_t/pallas_ct)", hist_mode)
         self.bundle_arrays, self.group_bins = build_bundle_arrays(train_data)
         ncols = (len(train_data.bundle.num_group_bins)
                  if train_data.bundle is not None
@@ -338,8 +337,7 @@ class SerialTreeLearner:
             # to the XLA partition scan where the lookup does apply
             # (ADVICE r3); the sparse pass owns its lookup everywhere
             from .wave import pallas_wave_active
-            fused_runs = (hist_mode in ("pallas_f", "pallas_ft",
-                                        "pallas_ct")
+            fused_runs = (hist_mode == "pallas_ct"
                           and pallas_wave_active(hist_mode, self.dtype))
             if lk != "auto" and (fused_runs or sparse_on):
                 Log.warning("tpu_wave_lookup=%s has no effect under %s "
@@ -347,17 +345,6 @@ class SerialTreeLearner:
                             "own lookup)", lk,
                             "tpu_sparse" if sparse_on
                             else "tpu_histogram_mode=%s" % hist_mode)
-            if (hist_mode in ("pallas_f", "pallas_ft")
-                    and train_data.num_data > 2_000_000):
-                # the fused kernels still take (N,1)/(N,3) operands,
-                # which pay TPU's 128-lane tile padding (~0.5 GB per
-                # million rows); the non-fused kernels got the compact
-                # layouts after the 10.5M-row OOM (pallas_wave.py)
-                Log.warning("tpu_histogram_mode=%s at %d rows: the fused "
-                            "kernels' per-row operands pay 128x lane "
-                            "padding in HBM and may OOM above ~4M rows; "
-                            "pallas_t (the auto choice) has compact "
-                            "layouts", hist_mode, train_data.num_data)
         else:
             self.wave_lookup = "onehot"
         # 4-bit packing (dense_nbits_bin.hpp:37 analog, ops/pack.py): when

@@ -79,13 +79,6 @@ def _hi_lo(wmat):
     return wh, wl
 
 
-def _split_weights_from_match(match, w3):
-    """(Cg, K) 0/1 match x (Cg, 3) channels -> (Cg, 3K) bf16 hi/lo."""
-    wmat = jnp.concatenate(
-        [match * w3[:, ch:ch + 1] for ch in range(3)], axis=1)  # (Cg, 3K)
-    return _hi_lo(wmat)
-
-
 def _split_weights_t(lid_ref, w3_ref, cid_ref):
     """Per-child masked weights in the ROW-VECTOR orientation: (3K, Cg)
     bf16 hi/lo from lid (1, Cg), w3 (3, Cg), cid (K, 1).
@@ -108,52 +101,6 @@ def _unpack4_t(xti, fc):
     tiles (ops/pack.py layout).  One copy shared by the transposed
     kernels so a pack-layout change cannot corrupt one of them."""
     return jnp.concatenate([xti & 15, xti >> 4], axis=0)[:fc]
-
-
-def _lookup_and_route(xint, lc, tbl_ref, *, fc, bundled):
-    """Shared fused-kernel routing: split-table lookup by leaf id (one-hot
-    contraction on the MXU) + the wave_pass decision algebra -> updated
-    leaf ids.  ONE copy so the two in-VMEM users (the row-major and
-    transposed fused kernels) cannot diverge from each other.
-
-    xint: (Cg, Fc) int32 unpacked bins;  lc: (Cg, 1) int32 leaf ids;
-    tbl_ref: (L, 10) f32 split table (ops/wave.py column layout).
-    """
-    cg = xint.shape[0]
-    L = tbl_ref.shape[0]
-    # f32 MXU with HIGHEST precision — table entries are integers < 2^24
-    # (column ids, thresholds, leaf ids) and must come back exact.
-    leaf_iota = jax.lax.broadcasted_iota(jnp.int32, (cg, L), 1)
-    leaf_oh = (lc == leaf_iota).astype(jnp.float32)
-    r = jax.lax.dot_general(
-        leaf_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)             # (Cg, 10)
-
-    active = r[:, 0:1] > 0.5
-    cj = r[:, 1:2].astype(jnp.int32)                     # (Cg, 1)
-    f_iota = jax.lax.broadcasted_iota(jnp.int32, (cg, fc), 1)
-    colv = jnp.sum(jnp.where(cj == f_iota, xint, 0), axis=1,
-                   keepdims=True)                        # (Cg, 1)
-    if bundled:
-        goff = r[:, 7:8].astype(jnp.int32)
-        span = r[:, 9:10].astype(jnp.int32)
-        in_range = (colv >= goff) & (colv < goff + span)
-        colv = jnp.where(in_range,
-                         colv - goff + r[:, 8:9].astype(jnp.int32),
-                         r[:, 4:5].astype(jnp.int32))
-    thr = r[:, 2:3].astype(jnp.int32)
-    is_cat = r[:, 3:4] > 0.5
-    # Boolean-BRANCH selects lower to an i8->i1 arith.trunci that Mosaic
-    # rejects on v5e ("Unsupported target bitwidth for truncation");
-    # carry the go-left decision as f32 0/1 and compare at the end.
-    one, zero = jnp.float32(1.0), jnp.float32(0.0)
-    gl = jnp.where(is_cat,
-                   jnp.where(colv == thr, one, zero),
-                   jnp.where(colv <= thr, one, zero))
-    gl = jnp.where(colv == r[:, 4:5].astype(jnp.int32),
-                   jnp.where(r[:, 5:6] > 0.5, one, zero), gl)
-    return jnp.where(active & (gl < 0.5), r[:, 6:7].astype(jnp.int32), lc)
 
 
 def _accum_hist(out_ref, xr, base, wh, wl, *, bp, fc, bsub, dims):
@@ -372,229 +319,21 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
 # offset/adjust/span).
 # --------------------------------------------------------------------------
 
-def _wave_fused_kernel(x_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
-                       lid_out_ref, out_ref,
-                       *, bp, fc, k, bsub, packed, bundled):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    xi = x_ref[:]
-    if packed:
-        from .pack import unpack4
-        xi = unpack4(xi, fc)
-    xint = xi.astype(jnp.int32)                          # (Cg, Fc)
-    x = xint.astype(jnp.float32)
-    cg = x.shape[0]
-
-    lc2 = _lookup_and_route(xint, lid_ref[:], tbl_ref, fc=fc,
-                            bundled=bundled)
-    lid_out_ref[:] = lc2
-
-    # ---- child histograms from the UPDATED leaf ids
-    match = (lc2 == cid_ref[:]).astype(jnp.float32)      # (Cg, K)
-    wh, wl = _split_weights_from_match(match, w3_ref[:])
-
-    xr = pltpu.repeat(x, bsub, axis=1)                   # (Cg, bsub*Fc)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (cg, bsub * fc), 1)
-    base = (lane // fc).astype(jnp.float32)
-    _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                dims=(((0,), (0,)), ((), ())))
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
-                                             "row_tile", "interpret",
-                                             "logical_cols"))
-def wave_partition_hist_pallas(X, leaf_id, w3, child_id, tbl,
-                               num_bins: int, bundled: bool = False,
-                               row_tile: int = 8192,
-                               interpret: bool = False,
-                               logical_cols: int = 0):
-    """Fused wave step: (new_leaf_id, (K, F, B, 3) child histograms).
-
-    X: (N, F) bins (or 4-bit packed with logical_cols set); leaf_id: (N,)
-    int32 BEFORE this wave's splits; w3: (N, 3) [g, h, mult];
-    child_id: (K,) target (smaller-child) leaves, -1 = inactive slot;
-    tbl: (L, 10) float32 per-leaf split table (ops/wave.py layout).
-    """
-    n, fdev = X.shape
-    fc = logical_cols or fdev
-    k = child_id.shape[0]
-    bp = _bin_pad(num_bins)
-    bsub, c = _tile_plan(n, fc, bp, row_tile)
-    pad = (-n) % c
-    lid2 = leaf_id[:, None]
-    w3f = w3.astype(jnp.float32)
-    if pad:
-        X = jnp.pad(X, ((0, pad), (0, 0)))
-        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
-        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
-    nch = (n + pad) // c
-
-    kernel = functools.partial(_wave_fused_kernel, bp=bp, fc=fc, k=k,
-                               bsub=bsub, packed=bool(logical_cols),
-                               bundled=bundled)
-    newlid, flat = pl.pallas_call(
-        kernel,
-        grid=(nch,),
-        in_specs=[
-            pl.BlockSpec((c, fdev), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 3), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(tbl.shape, lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(((n + pad), 1), jnp.int32),
-            jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(X, lid2, w3f, child_id[None, :], tbl)
-    h = flat.reshape(bp, fc, 3, k)[:num_bins]
-    return newlid[:n, 0], jnp.transpose(h, (3, 1, 0, 2))
-
-
-# --------------------------------------------------------------------------
-# v4 'pallas_ft': FUSED partition + histogram in the TRANSPOSED dot
-# orientation.  v3 (pallas_f) reads X once but inherits v1's dot shape —
-# ohᵀ @ w, which Mosaic realizes via an in-VMEM transpose of the one-hot
-# tile (measured ~30% slower end-to-end than v2).  The wave engine keeps
-# BOTH X (row-major, partition scan) and X_t (transposed) in HBM anyway,
-# so this kernel takes both: the routing algebra reads the row-major tile
-# (child ids come out row-shaped for free), the one-hot is generated
-# already transposed from X_t, and the MXU runs the native (Q, Cg) @
-# (Cg, 3K) form with no transpose anywhere.  Extra HBM read per wave:
-# one X tile (~N*F bytes) — noise next to the ~100x one-hot saving.
-# --------------------------------------------------------------------------
-
-def _wave_fused_kernel_ft(x_ref, xt_ref, lid_ref, w3_ref, cid_ref, tbl_ref,
-                          lid_out_ref, out_ref,
-                          *, bp, fc, k, bsub, packed, bundled):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    # ---- routing from the ROW-MAJOR tile (identical to _wave_fused_kernel)
-    xi = x_ref[:]
-    if packed:
-        from .pack import unpack4
-        xi = unpack4(xi, fc)
-    xint = xi.astype(jnp.int32)                          # (Cg, Fc)
-    cg = xint.shape[0]
-
-    lc2 = _lookup_and_route(xint, lid_ref[:], tbl_ref, fc=fc,
-                            bundled=bundled)
-    lid_out_ref[:] = lc2
-
-    # ---- histograms from the TRANSPOSED tile (identical to
-    # _wave_hist_kernel_t, with the match built from the UPDATED ids)
-    match = (lc2 == cid_ref[:]).astype(jnp.float32)      # (Cg, K)
-    wh, wl = _split_weights_from_match(match, w3_ref[:])
-
-    xti = xt_ref[:].astype(jnp.int32)                    # (Fdev, Cg)
-    if packed:
-        xti = _unpack4_t(xti, fc)
-    xt = xti.astype(jnp.float32)                         # (Fc, Cg)
-
-    xr = pltpu.repeat(xt, bsub, axis=0)                  # (bsub*Fc, Cg)
-    base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
-            // fc).astype(jnp.float32)
-    _accum_hist(out_ref, xr, base, wh, wl, bp=bp, fc=fc, bsub=bsub,
-                dims=(((1,), (0,)), ((), ())))
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
-                                             "row_tile", "interpret",
-                                             "logical_cols"))
-def wave_partition_hist_pallas_ft(X, X_t, leaf_id, w3, child_id, tbl,
-                                  num_bins: int, bundled: bool = False,
-                                  row_tile: int = 8192,
-                                  interpret: bool = False,
-                                  logical_cols: int = 0):
-    """Fused wave step, transposed-dot layout: same contract as
-    wave_partition_hist_pallas plus the transposed bin matrix X_t (F, N)
-    (packed: (ceil(F/2), N) with logical_cols set)."""
-    n, fdev = X.shape
-    fc = logical_cols or fdev
-    k = child_id.shape[0]
-    bp = _bin_pad(num_bins)
-    bsub, c = _tile_plan(n, fc, bp, row_tile)
-    pad = (-n) % c
-    lid2 = leaf_id[:, None]
-    w3f = w3.astype(jnp.float32)
-    if pad:
-        X = jnp.pad(X, ((0, pad), (0, 0)))
-        X_t = jnp.pad(X_t, ((0, 0), (0, pad)))
-        lid2 = jnp.pad(lid2, ((0, pad), (0, 0)), constant_values=-2)
-        w3f = jnp.pad(w3f, ((0, pad), (0, 0)))
-    nch = (n + pad) // c
-
-    kernel = functools.partial(_wave_fused_kernel_ft, bp=bp, fc=fc, k=k,
-                               bsub=bsub, packed=bool(logical_cols),
-                               bundled=bundled)
-    newlid, flat = pl.pallas_call(
-        kernel,
-        grid=(nch,),
-        in_specs=[
-            pl.BlockSpec((c, fdev), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((fdev, c), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((c, 3), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(tbl.shape, lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((c, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(((n + pad), 1), jnp.int32),
-            jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(X, X_t, lid2, w3f, child_id[None, :], tbl)
-    h = flat.reshape(bp, fc, 3, k)[:num_bins]
-    return newlid[:n, 0], jnp.transpose(h, (3, 1, 0, 2))
-
-
 # --------------------------------------------------------------------------
 # v5 'pallas_ct': FUSED partition + histogram, COMPACT table, pure
-# row-vector orientation.  Lessons from v3/v4 and the r03 OOM applied
-# together: every per-row operand is a row vector ((1, N) lid, (3, N)
-# w3 — no lane-padded columns), the split lookup contracts the COMPACT
-# (10, W) table against a (W, Cg) parent match (W/L of v3's (Cg, L)
-# one-hot), the routing algebra runs entirely on (1, Cg) rows derived
-# from the TRANSPOSED tile (colv comes from a masked sublane reduction
-# of Xt — no row-major X operand at all), and the histogram is the v2
-# MXU-native A @ B^T.  ONE read of Xt per wave, no XLA partition scan,
-# no transposes anywhere.
+# row-vector orientation.  (The v3/v4 fused kernels — 'pallas_f' and
+# 'pallas_ft' — were deleted in round 4: both lost every on-chip A/B to
+# the split pallas_t+scan pipeline and carried lane-padded (N, 1)/(N, 3)
+# operands, an OOM liability at >2M rows; see tools/AB_RESULTS.md and
+# BENCH_NOTES.md.)  Lessons from them and the r03 OOM applied together:
+# every per-row operand is a row vector ((1, N) lid, (3, N) w3 — no
+# lane-padded columns), the split lookup contracts the COMPACT (10, W)
+# table against a (W, Cg) parent match (W/L of the (Cg, L) one-hot), the
+# routing algebra runs entirely on (1, Cg) rows derived from the
+# TRANSPOSED tile (colv comes from a masked sublane reduction of Xt —
+# no row-major X operand at all), and the histogram is the v2 MXU-native
+# A @ B^T.  ONE read of Xt per wave, no XLA partition scan, no
+# transposes anywhere.
 # --------------------------------------------------------------------------
 
 def _wave_fused_kernel_ct(xt_ref, lid_ref, w3_ref, cid_ref, tblt_ref,

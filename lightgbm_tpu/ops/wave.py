@@ -52,7 +52,7 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
 # engine/learner gate imports THIS tuple so adding a kernel variant is a
 # one-line change.  Lives here (not pallas_wave.py) so CPU-only installs
 # never import jax.experimental.pallas just to validate a config.
-WAVE_ONLY_MODES = ("pallas_t", "pallas_f", "pallas_ft", "pallas_ct")
+WAVE_ONLY_MODES = ("pallas_t", "pallas_ct")
 
 
 def _bin_pad(num_bins: int) -> int:
@@ -95,7 +95,7 @@ def pallas_wave_active(hist_mode: str, hist_dtype=jnp.float32) -> bool:
 def transposed_wave_active(hist_mode: str, hist_dtype=jnp.float32) -> bool:
     """True when the running kernel is one of the TRANSPOSED layouts —
     i.e. a per-booster (F, N) Xt is worth materializing."""
-    return (hist_mode in ("pallas_t", "pallas_ft", "pallas_ct")
+    return (hist_mode in ("pallas_t", "pallas_ct")
             and pallas_wave_active(hist_mode, hist_dtype))
 
 
@@ -181,11 +181,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # their end-to-end win is validated; precision is handled by the bf16
     # hi/lo weight split (manual rounding — Mosaic's cast truncates).
     use_pallas_hist = pallas_wave_active(hist_mode, hist_dtype)
-    # 'pallas_ft' routes from row-major X and contracts from X_t — it is
-    # both transposed (needs Xt, rehists via the v2 kernel) and fused;
-    # 'pallas_ct' (v5) is fused, compact-table, and reads ONLY Xt
-    pallas_transposed = hist_mode in ("pallas_t", "pallas_ft", "pallas_ct")
-    pallas_fused = hist_mode in ("pallas_f", "pallas_ft", "pallas_ct")
+    # 'pallas_ct' (v5) is fused (partition + histogram in one kernel,
+    # ONE read of Xt per wave) and transposed; the earlier fused
+    # variants pallas_f/pallas_ft were deleted after losing every
+    # on-chip A/B to pallas_t (tools/AB_RESULTS.md, BENCH_NOTES.md r4)
+    pallas_transposed = hist_mode in ("pallas_t", "pallas_ct")
+    pallas_fused = hist_mode == "pallas_ct"
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -319,29 +320,16 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             - 'gather': r = tbl[leaf_id] — the form the sparse pass
               already uses; XLA's TPU gather economics decide.
 
-            On TPU the histogram half runs as the fused Pallas kernel
-            (one-hot generated in VMEM, ops/pallas_wave.py) and the scan
-            below only partitions; 'pallas_f' fuses BOTH halves into one
-            kernel — a single read of X per wave.
+            On TPU the histogram half runs as the Pallas kernel (one-hot
+            generated in VMEM, ops/pallas_wave.py) and the scan below
+            only partitions; 'pallas_ct' fuses BOTH halves into one
+            kernel — a single read of Xt per wave.
             """
             if use_pallas_hist and pallas_fused:
-                if hist_mode == "pallas_ct":
-                    from .pallas_wave import wave_partition_hist_pallas_ct
-                    return wave_partition_hist_pallas_ct(
-                        Xt, leaf_id, w3,
-                        jnp.where(valid, small_id, -1), cols, psrc,
-                        hist_bins, bundled=has_bundle,
-                        logical_cols=packed_cols)
-                if pallas_transposed:
-                    from .pallas_wave import wave_partition_hist_pallas_ft
-                    return wave_partition_hist_pallas_ft(
-                        X, Xt, leaf_id, w3,
-                        jnp.where(valid, small_id, -1), tbl,
-                        hist_bins, bundled=has_bundle,
-                        logical_cols=packed_cols)
-                from .pallas_wave import wave_partition_hist_pallas
-                return wave_partition_hist_pallas(
-                    X, leaf_id, w3, jnp.where(valid, small_id, -1), tbl,
+                from .pallas_wave import wave_partition_hist_pallas_ct
+                return wave_partition_hist_pallas_ct(
+                    Xt, leaf_id, w3,
+                    jnp.where(valid, small_id, -1), cols, psrc,
                     hist_bins, bundled=has_bundle,
                     logical_cols=packed_cols)
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \

@@ -323,14 +323,15 @@ class Config:
         # auto = device for >=100k-row batches on TPU, host otherwise.
         "tpu_predict": ("str", "auto"),
         # 'auto' | 'scatter' | 'onehot' | 'pallas' | 'pallas_t' |
-        # 'pallas_f' | 'pallas_ft' | 'pallas_ct' — histogram kernel ('pallas' =
-        # exact-engine per-leaf kernel, 'pallas_t' = wave kernel with
-        # MXU-native transposed operands, 'pallas_f' = fused partition+
-        # histogram wave kernel, 'pallas_ft' = fused AND transposed —
-        # routing from row-major X, MXU contraction from X_t).  auto =
-        # pallas_t on TPU when the wave engine runs it (f32, dense,
-        # serial/data learner; measured fastest on v5e), else onehot on
-        # TPU, scatter elsewhere.
+        # 'pallas_ct' — histogram kernel ('pallas' = exact-engine
+        # per-leaf kernel, 'pallas_t' = wave kernel with MXU-native
+        # transposed operands, 'pallas_ct' = fused partition+histogram
+        # wave kernel, compact split table, one read of X_t per wave).
+        # auto = pallas_t on TPU when the wave engine runs it (f32,
+        # dense, serial/data learner; measured fastest on v5e), else
+        # onehot on TPU, scatter elsewhere.  (pallas_f/pallas_ft were
+        # deleted in r4: lost every on-chip A/B, padded-operand OOM
+        # liability — tools/AB_RESULTS.md.)
         "tpu_histogram_mode": ("str", "auto"),
         # 'auto' | 'exact' | 'wave' — growth schedule (ops/wave.py):
         # 'exact' is the reference's one-split-at-a-time leaf-wise order;

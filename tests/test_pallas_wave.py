@@ -1,8 +1,9 @@
 """Wave-histogram Pallas kernels vs the XLA oracle (interpret mode, CPU).
 
-Covers all operand layouts (v1 row-major, v2 transposed, v3 fused,
-v4 fused+transposed, v5 fused compact-table row-vector) and the 4-bit
-packed input path of each.
+Covers the shipped kernel layouts (v1 row-major `pallas`, v2 transposed
+`pallas_t`, v5 fused compact-table row-vector `pallas_ct`) and the
+4-bit packed input path of each.  The v3/v4 fused kernels and their
+tests were deleted in round 4 (measured losers — BENCH_NOTES.md).
 """
 import numpy as np
 import jax.numpy as jnp
@@ -41,8 +42,7 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft",
-                                  "pallas_ct"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_ct"])
 def test_pallas_wave_data_parallel_constructs(mode):
     """tree_learner=data + a wave-only pallas mode must reach the mesh
     wave branch (the base constructor's exact-engine fallback maps these
@@ -59,8 +59,7 @@ def test_pallas_wave_data_parallel_constructs(mode):
     assert bst.predict(X).shape == (1600,)
 
 
-@pytest.mark.parametrize("mode", ["pallas_t", "pallas_f", "pallas_ft",
-                                  "pallas_ct"])
+@pytest.mark.parametrize("mode", ["pallas_t", "pallas_ct"])
 def test_pallas_wave_mode_plumbing(mode):
     """Wave-only pallas modes resolve to wave growth and train (falling
     back to the einsum path off-TPU); exact growth rejects them."""
@@ -124,70 +123,6 @@ def _route_numpy(X, leaf_id, tbl, bundled=False):
     return np.where(active & ~gl, r[:, 6].astype(np.int32), leaf_id)
 
 
-@pytest.mark.parametrize("layout", ["v3", "v4"])
-def test_fused_kernel_matches_oracle(layout):
-    from lightgbm_tpu.ops.pallas_wave import (wave_partition_hist_pallas,
-                                              wave_partition_hist_pallas_ft)
-
-    X, leaf_id, w3, cid, b = _data(n=2500, f=7, b=14, k=5, seed=9)
-    L = 16
-    rng = np.random.default_rng(10)
-    leaf_id = rng.integers(0, 8, size=len(X)).astype(np.int32)
-    tbl = np.zeros((L, 10), np.float32)
-    for leaf in (1, 3, 5):                  # three leaves split this wave
-        tbl[leaf] = [1, rng.integers(0, 7), rng.integers(0, 14), 0,
-                     0, rng.integers(0, 2), 8 + leaf, 0, 0, 0]
-
-    want_lid = _route_numpy(X, leaf_id, tbl)
-    want_hist = np.array(wave_histogram_reference(
-        jnp.asarray(X), jnp.asarray(want_lid), jnp.asarray(w3),
-        jnp.asarray(cid), b))
-    want_hist[np.asarray(cid) < 0] = 0.0
-
-    if layout == "v3":
-        got_lid, got_hist = wave_partition_hist_pallas(
-            jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
-            jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True)
-    else:
-        got_lid, got_hist = wave_partition_hist_pallas_ft(
-            jnp.asarray(X), jnp.asarray(X.T), jnp.asarray(leaf_id),
-            jnp.asarray(w3), jnp.asarray(cid), jnp.asarray(tbl), b,
-            interpret=True)
-    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
-    np.testing.assert_allclose(np.asarray(got_hist), want_hist,
-                               rtol=5e-4, atol=5e-4)
-
-
-@pytest.mark.parametrize("layout", ["v3", "v4"])
-def test_fused_kernel_packed(layout):
-    from lightgbm_tpu.ops.pallas_wave import (wave_partition_hist_pallas,
-                                              wave_partition_hist_pallas_ft)
-
-    X, leaf_id, w3, cid, b = _data(n=2000, f=9, b=15, seed=11)
-    rng = np.random.default_rng(12)
-    leaf_id = rng.integers(0, 6, size=len(X)).astype(np.int32)
-    tbl = np.zeros((8, 10), np.float32)
-    tbl[2] = [1, 4, 7, 0, 0, 1, 6, 0, 0, 0]
-    want_lid = _route_numpy(X, leaf_id, tbl)
-    want_hist = np.array(wave_histogram_reference(
-        jnp.asarray(X), jnp.asarray(want_lid), jnp.asarray(w3),
-        jnp.asarray(cid), b))
-    want_hist[np.asarray(cid) < 0] = 0.0
-    packed = pack4_host(X)
-    if layout == "v3":
-        got_lid, got_hist = wave_partition_hist_pallas(
-            jnp.asarray(packed), jnp.asarray(leaf_id), jnp.asarray(w3),
-            jnp.asarray(cid), jnp.asarray(tbl), b, interpret=True,
-            logical_cols=X.shape[1])
-    else:
-        got_lid, got_hist = wave_partition_hist_pallas_ft(
-            jnp.asarray(packed), jnp.asarray(packed.T),
-            jnp.asarray(leaf_id), jnp.asarray(w3), jnp.asarray(cid),
-            jnp.asarray(tbl), b, interpret=True,
-            logical_cols=X.shape[1])
-    np.testing.assert_array_equal(np.asarray(got_lid), want_lid)
-    np.testing.assert_allclose(np.asarray(got_hist), want_hist,
-                               rtol=5e-4, atol=5e-4)
 
 
 def test_auto_hist_mode_resolution(monkeypatch):

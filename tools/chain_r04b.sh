@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 follow-up stage: once the main chain has released the chip,
+# capture the never-yet-captured on-chip profiler trace of the wave
+# engine (ROADMAP.md "wave-loop residue") at 1M and, time permitting,
+# at the flagship 10.5M — ranks the partition scan / split finder /
+# dispatch overhead for the next optimization round.
+cd /root/repo || exit 1
+LOG=/tmp/chain_r04.log
+log() { echo "[chain4b] $(date -u +%F\ %T) $*" >> "$LOG"; }
+log "armed (waits for chain_r04.sh)"
+while pgrep -f "chain_r04\.sh" > /dev/null; do sleep 120; done
+# hard stop: leave the chip alone within 75 min of the 12h round end
+END=${CHAIN4B_END_EPOCH:-$(( $(date +%s) + 3600 ))}
+probe_ok() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+from lightgbm_tpu.utils.common import probe_device
+import sys
+sys.exit(0 if probe_device(timeout=120) == "tpu" else 1)
+EOF
+}
+while :; do
+  now=$(date +%s)
+  [ "$now" -ge "$END" ] && { log "budget spent; exit"; exit 0; }
+  if probe_ok; then break; fi
+  sleep 120
+done
+log "profiling 1M trace"
+timeout 1200 python tools/tpu_profile.py 999424 /tmp/tpu_trace_1m > /tmp/profile_1m.out 2>&1
+log "profile 1M rc=$?"
+if [ "$(date +%s)" -lt "$(( END - 1500 ))" ] && probe_ok; then
+  log "profiling flagship trace"
+  timeout 1500 python tools/tpu_profile.py 10500000 /tmp/tpu_trace_fs > /tmp/profile_fs.out 2>&1
+  log "profile flagship rc=$?"
+fi
+log "chain4b complete"

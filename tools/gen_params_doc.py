@@ -76,7 +76,7 @@ NOTES = {
                        "(bit-identical trees, ~L/W less lookup traffic). "
                        "auto: compact on TPU, onehot elsewhere",
     "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t / "
-                          "pallas_f / pallas_ft / pallas_ct histogram kernels; auto = "
+                          "pallas_ct histogram kernels; auto = "
                           "pallas_t on TPU under the wave engine (f32, "
                           "dense, serial/data), else onehot (TPU) / "
                           "scatter",

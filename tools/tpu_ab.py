@@ -48,7 +48,7 @@ def main():
     from tools.bench_modes import make_data, run
     X, y = make_data(n)
     combos = [("onehot", 32), ("onehot", 64), ("pallas", 32),
-              ("pallas_t", 32), ("pallas_f", 32), ("pallas_f", 64)]
+              ("pallas_t", 32), ("pallas_ct", 32), ("pallas_ct", 64)]
     for mode, width in combos:
         t0 = time.time()
         try:

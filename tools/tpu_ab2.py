@@ -108,23 +108,15 @@ def append(line):
 
 
 FOLLOWUP = [
-    # round-3 second pass: the fused+transposed kernel (pallas_ft), the
-    # post-Mosaic-fix rerun of pallas_f W=32, and the W=64 arm of the
-    # current leader pallas_t
-    ("engine pallas_ft W=32",
-     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 32}),
-    ("engine pallas_ft W=64",
-     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 64}),
-    ("engine pallas_f W=32",
-     {"kind": "dense", "n": 0, "mode": "pallas_f", "width": 32}),
+    # round-3 second pass (historical: the pallas_f/pallas_ft arms it
+    # carried were deleted with those kernels in r4 — measured losers,
+    # tools/AB_RESULTS.md 11:30 block)
     ("engine pallas_t W=64",
      {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 64}),
     # width scaling: each sweep pays one pass over X regardless of W, so
     # doubling W nearly halves the sweeps per tree — quality permitting
     ("engine pallas_t W=128",
      {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 128}),
-    ("engine pallas_ft W=128",
-     {"kind": "dense", "n": 0, "mode": "pallas_ft", "width": 128}),
     ("engine onehot   W=32",
      {"kind": "dense", "n": 0, "mode": "onehot", "width": 32}),
     # exact-order waves under the pallas kernel (the order-sensitive
@@ -225,16 +217,14 @@ def main():
         run_combos(combos, n)
         return
     combos = [
-        ("engine pallas_f W=32",
-         {"kind": "dense", "n": n, "mode": "pallas_f", "width": 32}),
         ("engine onehot   W=64",
          {"kind": "dense", "n": n, "mode": "onehot", "width": 64}),
         ("engine pallas_t W=32",
          {"kind": "dense", "n": n, "mode": "pallas_t", "width": 32}),
         ("engine pallas   W=32",
          {"kind": "dense", "n": n, "mode": "pallas", "width": 32}),
-        ("engine pallas_f W=64",
-         {"kind": "dense", "n": n, "mode": "pallas_f", "width": 64}),
+        ("engine pallas_ct W=32",
+         {"kind": "dense", "n": n, "mode": "pallas_ct", "width": 32}),
         ("bosch1Mx968 sparse exact",
          {"kind": "sparse", "n": 1_000_000, "width": 1, "timeout": 2700,
           "extra": {"tpu_sparse": True, "tpu_growth": "exact"}}),
