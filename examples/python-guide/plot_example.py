@@ -1,0 +1,52 @@
+"""Plotting utilities (reference python-guide/plot_example.py scope):
+metric curves during training, split/gain importance, and a rendered
+tree.  Figures are written to /tmp (no display needed).
+
+Run from the repo root:  python examples/python-guide/plot_example.py
+Requires matplotlib; tree rendering additionally uses graphviz when
+available (falls back with a note when not).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:
+    raise SystemExit("plot_example needs matplotlib")
+
+rng = np.random.default_rng(1)
+X = rng.normal(size=(10_000, 6))
+y = (X[:, 0] - 0.5 * X[:, 2] + 0.2 * rng.normal(size=10_000) > 0).astype(float)
+train_set = lgb.Dataset(X[:8000], label=y[:8000],
+                        feature_name=[f"f{i}" for i in range(6)])
+valid_set = train_set.create_valid(X[8000:], label=y[8000:])
+
+evals = {}
+bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                 "metric": ["auc", "binary_logloss"], "verbose": -1},
+                train_set, num_boost_round=50, valid_sets=[valid_set],
+                valid_names=["valid"], verbose_eval=False,
+                callbacks=[lgb.record_evaluation(evals)])
+
+ax = lgb.plot_metric(evals, metric="binary_logloss")
+ax.figure.savefig("/tmp/plot_metric.png")
+print("wrote /tmp/plot_metric.png")
+
+ax = lgb.plot_importance(bst, importance_type="gain", max_num_features=6)
+ax.figure.savefig("/tmp/plot_importance.png")
+print("wrote /tmp/plot_importance.png")
+
+try:
+    graph = lgb.create_tree_digraph(bst, tree_index=0)
+    graph.render("/tmp/plot_tree", format="png", cleanup=True)
+    print("wrote /tmp/plot_tree.png")
+except Exception as e:   # graphviz binary not installed
+    print("tree digraph skipped (%s)" % e)

@@ -2,6 +2,11 @@
 
 Run from the repo root:  python examples/python-guide/simple_example.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
 import numpy as np
 
 import lightgbm_tpu as lgb

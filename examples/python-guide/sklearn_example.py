@@ -1,0 +1,57 @@
+"""scikit-learn interface (reference python-guide/sklearn_example.py
+scope): regressor with early stopping, grid search over the estimator,
+classifier probabilities, and a ranker with query groups.
+
+Run from the repo root:  python examples/python-guide/sklearn_example.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(5)
+
+# ---- regression with early stopping on a holdout
+X = rng.normal(size=(20_000, 8))
+y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=20_000)
+X_tr, X_te, y_tr, y_te = X[:16_000], X[16_000:], y[:16_000], y[16_000:]
+
+reg = lgb.LGBMRegressor(n_estimators=200, num_leaves=31, learning_rate=0.1)
+reg.fit(X_tr, y_tr, eval_set=[(X_te, y_te)], eval_metric="l2",
+        early_stopping_rounds=10, verbose=False)
+pred = reg.predict(X_te, num_iteration=reg.best_iteration_)
+print("regression rmse: %.4f (best_iter=%s)"
+      % (float(np.sqrt(np.mean((pred - y_te) ** 2))), reg.best_iteration_))
+
+# ---- grid search over the sklearn estimator
+try:
+    from sklearn.model_selection import GridSearchCV
+    gs = GridSearchCV(lgb.LGBMRegressor(n_estimators=20),
+                      {"num_leaves": [15, 31], "learning_rate": [0.05, 0.1]},
+                      cv=3)
+    gs.fit(X_tr[:4000], y_tr[:4000])
+    print("grid search best:", gs.best_params_)
+except ImportError:
+    print("scikit-learn not installed; grid search skipped")
+
+# ---- classifier probabilities
+yc = (y > 0).astype(int)
+clf = lgb.LGBMClassifier(n_estimators=40, num_leaves=31)
+clf.fit(X_tr, yc[:16_000])
+proba = clf.predict_proba(X_te)
+print("classifier accuracy: %.3f"
+      % ((proba[:, 1] > 0.5).astype(int) == yc[16_000:]).mean())
+
+# ---- ranker with query groups
+n_q, per_q = 200, 20
+Xr = rng.normal(size=(n_q * per_q, 5))
+rel = (Xr[:, 0] + 0.3 * rng.normal(size=n_q * per_q))
+yr = np.clip((rel * 2).astype(int) - rel.astype(int), 0, 4)
+group = np.full(n_q, per_q)
+rk = lgb.LGBMRanker(n_estimators=30, num_leaves=15)
+rk.fit(Xr, yr, group=group)
+print("ranker trained; scores head:", np.round(rk.predict(Xr[:3]), 3))

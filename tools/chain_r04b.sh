@@ -24,6 +24,9 @@ while :; do
   if probe_ok; then break; fi
   sleep 120
 done
+if [ "$(date +%s)" -ge "$(( END - 1200 ))" ]; then
+  log "no budget for the 1M trace; exit"; exit 0
+fi
 log "profiling 1M trace"
 timeout 1200 python tools/tpu_profile.py 999424 /tmp/tpu_trace_1m > /tmp/profile_1m.out 2>&1
 log "profile 1M rc=$?"
