@@ -71,9 +71,13 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     the persistent cache a retry (or the driver's round-end bench) reuses
     the serialized executable and reaches its first timed iteration in
     seconds.  Resolution order: explicit arg > LGBM_TPU_COMPILE_CACHE env
-    (set to "0" to disable) > /tmp/lgbm_tpu_xla_cache.  Must run before
-    the first compilation; safe no-op if the config knobs are missing.
-    Returns the directory in use, or None when disabled/unavailable.
+    (set to "0" to disable) > /tmp/lgbm_tpu_xla_cache.  Without an
+    explicit ``cache_dir`` the cache engages on the TPU backend ONLY:
+    CPU executables embed host-specific AOT machine features and their
+    serialization has been observed to segfault (and CPU compiles are
+    cheap anyway).  Must run before the first compilation; safe no-op
+    if the config knobs are missing.  Returns the directory in use, or
+    None when disabled/unavailable.
     """
     import os
 
@@ -83,6 +87,18 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
                                     "/tmp/lgbm_tpu_xla_cache")
     if not d or d == "0":
         return None
+    if cache_dir is None and "LGBM_TPU_COMPILE_CACHE" not in os.environ:
+        # default-on only for TPU: CPU executables carry host-specific
+        # AOT machine features, and serializing them has been observed
+        # to SEGFAULT sporadically (jax compilation_cache
+        # put_executable_and_time) — CPU compiles are seconds anyway.
+        # An explicit cache_dir argument or the env var overrides
+        # (tests use tmpdirs; operators opting in know their host).
+        try:
+            if jax.default_backend() != "tpu":
+                return None
+        except Exception:
+            return None
     try:
         os.makedirs(d, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", d)

@@ -86,3 +86,21 @@ def test_compilation_cache_disabled_by_env():
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_compilation_cache_default_off_on_cpu():
+    """Without an explicit dir the cache must NOT engage on CPU —
+    serializing host-feature-specific CPU executables has segfaulted
+    (observed in-process during the r4 suite run); TPU is the target."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from lightgbm_tpu.utils.common import enable_compilation_cache\n"
+        "assert enable_compilation_cache() is None\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if k != "LGBM_TPU_COMPILE_CACHE"}   # operator opt-in env must
+    # not leak in and flip the gate this test pins
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -45,6 +45,13 @@ SHAPES = {
         "num_leaves": 255, "max_bin": 63, "learning_rate": 0.1,
         "min_data_in_leaf": 1}, warmup=2, measured=5, timeout=2700,
         query_size=120),
+    # Yahoo-LTR stand-in (473,134 x 700 ranking, GPU-Performance.md:80):
+    # the wide-feature ranking point of the reference's six-dataset table
+    "yahoo": dict(n=473_134, f=700, params={
+        "objective": "lambdarank", "metric": "ndcg", "ndcg_eval_at": "1,10",
+        "num_leaves": 255, "max_bin": 63, "learning_rate": 0.1,
+        "min_data_in_leaf": 1}, warmup=2, measured=5, timeout=2700,
+        query_size=23),
     # width arm at the WIDE shape: epsilon's in-VMEM block at the auto
     # W=32 is 2000*64*3*32*4B ~= 49 MB — inside the 64 MB gate, so auto
     # runs pallas_t W=32; this arm measures W=16 against it (wide

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-4 stage: the Yahoo-LTR shape's TPU arm, after chain_r04b frees
+# the chip; budget-gated like the other follow-ups.
+cd /root/repo || exit 1
+LOG=/tmp/chain_r04.log
+log() { echo "[chain4c] $(date -u +%F\ %T) $*" >> "$LOG"; }
+log "armed (waits for chain_r04.sh AND chain_r04b.sh)"
+# r04b can budget-exit while the MAIN chain still owns the chip — wait
+# on both so the yahoo arm never contends with a running measurement
+while pgrep -f "chain_r04\.sh" > /dev/null || \
+      pgrep -f "chain_r04b\.sh" > /dev/null; do sleep 120; done
+END=${CHAIN4C_END_EPOCH:-$(( $(date +%s) + 1800 ))}
+[ "$(date +%s)" -ge "$(( END - 600 ))" ] && { log "no budget; exit"; exit 0; }
+SUITE_DEADLINE_S=$(( END - $(date +%s) - 120 )) timeout $(( END - $(date +%s) )) \
+  python tools/bench_suite.py yahoo >> "$LOG" 2>&1
+log "yahoo arm rc=$?"
