@@ -111,8 +111,10 @@ class GBDT:
         from ..ops.learner import SerialTreeLearner
         from ..parallel.mesh import create_tree_learner
         old = self.learner
+        from ..ops.sparse_mxu import ChunkedSparseStore
         from ..ops.sparse_store import SparseDeviceStore
-        old_sparse = isinstance(getattr(old, "X", None), SparseDeviceStore)
+        old_sparse = isinstance(getattr(old, "X", None),
+                                (SparseDeviceStore, ChunkedSparseStore))
         if (type(old) is SerialTreeLearner and old_sparse
                 and bool(config.tpu_sparse)):
             # reuse the device sparse store — train_data is unchanged on a

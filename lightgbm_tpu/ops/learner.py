@@ -288,20 +288,40 @@ class SerialTreeLearner:
                         "supports the serial and data-parallel learners "
                         "only")
             sparse_on = False
+        sparse_kernel = bool(config.tpu_sparse_kernel)
+        if sparse_kernel and not sparse_on:
+            Log.warning("tpu_sparse_kernel=true has no effect without "
+                        "tpu_sparse=true")
+            sparse_kernel = False
         if sparse_on:
             if hist_mode.startswith("pallas"):
                 Log.fatal("tpu_sparse=true is incompatible with "
                           "tpu_histogram_mode=%s (the pallas kernels are "
                           "dense-only)", hist_mode)
-            # both engines take the store: exact scans nonzeros per
-            # split, wave amortizes the O(nnz) pass over W splits but
-            # pays W split-column materializations — measured SLOWER on
-            # the CPU mesh (BENCH_NOTES.md) and unproven on chip, so
-            # auto growth stays exact; an explicit tpu_growth=wave is
-            # honored
-            if str(config.tpu_growth) == "auto":
-                growth = "exact"
-            hist_mode = "sparse"
+            if sparse_kernel and dp_learner:
+                Log.warning("tpu_sparse_kernel=true ignored under the "
+                            "data-parallel learner (the mesh sparse grow "
+                            "shards the coordinate store)")
+                sparse_kernel = False
+            if sparse_kernel:
+                # entry-chunk MXU store (ops/sparse_mxu.py) — wave-only:
+                # the whole design amortizes one O(nnz) pass over W
+                # splits and feeds the MXU per chunk
+                if str(config.tpu_growth) == "exact":
+                    Log.fatal("tpu_sparse_kernel=true requires wave "
+                              "growth (tpu_growth=exact scans per leaf)")
+                growth = "wave"
+                hist_mode = "sparse_mxu"
+            else:
+                # both engines take the coordinate store: exact scans
+                # nonzeros per split, wave amortizes the O(nnz) pass
+                # over W splits but pays W split-column
+                # materializations — measured SLOWER on the CPU mesh
+                # (BENCH_NOTES.md) and unproven on chip, so auto growth
+                # stays exact; an explicit tpu_growth=wave is honored
+                if str(config.tpu_growth) == "auto":
+                    growth = "exact"
+                hist_mode = "sparse"
             self.hist_mode = hist_mode
         self.sparse_on = sparse_on
         self.sparse_col_cap = 0
@@ -409,18 +429,27 @@ class SerialTreeLearner:
             # dense device_data meanwhile
             self.X = device_data
         elif sparse_on:
+            from .sparse_mxu import ChunkedSparseStore, build_chunked_store
             from .sparse_store import (SparseDeviceStore,
                                        build_sparse_store,
                                        column_fill_bins)
             self._row_pad = 0
-            if (isinstance(device_data, SparseDeviceStore)
+            want_store = (ChunkedSparseStore if sparse_kernel
+                          else SparseDeviceStore)
+            if (isinstance(device_data, want_store)
                     and device_sparse_col_cap > 0):
                 # reset_config reuse: same train_data -> same store
                 self.X = device_data
                 self.sparse_col_cap = device_sparse_col_cap
-                self.sparse_device_bytes = 4 * (
-                    3 * int(device_data.nz_row.shape[0])
-                    + 2 * int(device_data.fill.shape[0]) + 1)
+                if sparse_kernel:
+                    nc, e = (int(s) for s in device_data.ent_bin.shape)
+                    self.sparse_device_bytes = 4 * (
+                        2 * nc * e + nc
+                        + 2 * int(device_data.fill.shape[0]) + 1)
+                else:
+                    self.sparse_device_bytes = 4 * (
+                        3 * int(device_data.nz_row.shape[0])
+                        + 2 * int(device_data.fill.shape[0]) + 1)
             else:
                 nbins_dev = (self.group_bins
                              if train_data.bundle is not None
@@ -433,8 +462,10 @@ class SerialTreeLearner:
                     fill = column_fill_bins(train_data.num_bin_arr,
                                             train_data.default_bin_arr,
                                             train_data.bundle)
+                build = (build_chunked_store if sparse_kernel
+                         else build_sparse_store)
                 self.X, self.sparse_col_cap, self.sparse_device_bytes = \
-                    build_sparse_store(binned, fill, nbins_dev)
+                    build(binned, fill, nbins_dev)
         elif (device_data is not None
                 and device_packed_cols == self.packed_cols):
             self.X = device_data
@@ -460,7 +491,8 @@ class SerialTreeLearner:
         # kernels take the full-N mask form and keep the legacy path.
         self.row_capacities = (
             default_row_capacities(train_data.num_data + self._row_pad)
-            if hist_mode not in ("pallas", "sparse") + WAVE_ONLY_MODES
+            if hist_mode not in ("pallas", "sparse",
+                                 "sparse_mxu") + WAVE_ONLY_MODES
             else ())
         # distributed learners (psum_axis set) own their grow construction
         # in parallel/mesh.py — including the wave-vs-voting choice

@@ -166,7 +166,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     W = max(1, min(wave_width, L - 1))
     chunk = max(int(chunk), 256)      # guard tpu_wave_chunk<=0 etc.
     hist_bins = group_bins if has_bundle else num_bins
-    sparse_mode = hist_mode == "sparse"
+    sparse_mode = hist_mode in ("sparse", "sparse_mxu")
+    # 'sparse_mxu': X is a ChunkedSparseStore (ops/sparse_mxu.py) and
+    # sparse_col_cap its per-column CHUNK bound; child histograms come
+    # from the entry-chunk MXU kernel on TPU (segment_sum oracle form
+    # elsewhere) instead of a segment_sum over the coordinate store
+    mxu_sparse = hist_mode == "sparse_mxu"
     if sparse_mode and packed_cols:
         raise ValueError("tpu_sparse and 4-bit packing are exclusive")
     # the bin one-hot holds only 0/1 — exact in bf16 — and is the dominant
@@ -242,6 +247,16 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         # the W chosen split columns; all W child histograms are ONE
         # segment_sum over the nonzeros
         def sparse_child_hists(lid, ids, valid):
+            if mxu_sparse:
+                from .sparse_mxu import (chunked_child_hists_ref,
+                                         sparse_wave_histogram_mxu)
+                cid = jnp.where(valid, ids, -1)
+                if (jax.default_backend() == "tpu"
+                        and hist_dtype == jnp.float32):
+                    return sparse_wave_histogram_mxu(
+                        X, lid, w3, cid, hist_bins, Fc)
+                return chunked_child_hists_ref(
+                    X, lid, w3, cid, hist_bins, Fc, L)
             slot_tbl = jnp.full(L, -1, jnp.int32).at[
                 jnp.where(valid, ids, L)].set(
                     jnp.arange(W, dtype=jnp.int32), mode="drop")
@@ -278,13 +293,15 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             return jnp.where(active & ~gl, r[:, 6].astype(jnp.int32), lc)
 
         def sparse_wave_pass(lid, tbl, small_id, valid, col_ids):
-            from .sparse_store import sparse_split_column
+            if mxu_sparse:
+                from .sparse_mxu import chunked_split_column as _colfn
+            else:
+                from .sparse_store import sparse_split_column as _colfn
             r = jnp.take(tbl, lid, axis=0)                 # (N, 10)
             cj = r[:, 1].astype(jnp.int32)
             colv = jnp.zeros(n, jnp.int32)
             for w in range(W):                             # static W
-                vals = sparse_split_column(X, col_ids[w], n,
-                                           sparse_col_cap)
+                vals = _colfn(X, col_ids[w], n, sparse_col_cap)
                 colv = jnp.where(cj == col_ids[w], vals, colv)
             new_lid = route_rows(r, colv, lid)
             return new_lid, sparse_child_hists(new_lid, small_id, valid)
@@ -439,7 +456,14 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
         # ---- root
         root_sums = maybe_psum(jnp.sum(w3, axis=0))
-        if sparse_mode:
+        if mxu_sparse:
+            # root histogram through the same kernel call shape as the
+            # wave passes (one compiled executable): slot 0 targets the
+            # root, the other W-1 slots are inactive
+            hist0 = maybe_psum(sparse_child_hists(
+                leaf_id, jnp.zeros(W, jnp.int32),
+                jnp.arange(W) == 0)[0])
+        elif sparse_mode:
             from .sparse_store import leaf_histogram_sparse
             hist0 = maybe_psum(leaf_histogram_sparse(
                 X, grad, hess, leaf_id, 0, row_mult, hist_bins, Fc))

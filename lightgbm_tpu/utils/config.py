@@ -133,6 +133,7 @@ PARAMETER_SET = {
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
+    "tpu_sparse_kernel",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -379,6 +380,12 @@ class Config:
         # nonzero entries instead of an O(N*F) dense pass.  Exact engine
         # under the serial and data-parallel learners; default dense.
         "tpu_sparse": ("bool", False),
+        # entry-chunk MXU store (ops/sparse_mxu.py): with tpu_sparse=true,
+        # replace the segment_sum coordinate store with fixed-size
+        # per-column entry chunks whose histograms are small MXU
+        # contractions inside a Pallas kernel (the OrderedSparseBin
+        # economics, TPU form).  Forces wave growth; serial learner only.
+        "tpu_sparse_kernel": ("bool", False),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

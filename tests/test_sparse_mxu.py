@@ -1,0 +1,200 @@
+"""Entry-chunk MXU sparse store (ops/sparse_mxu.py): build, histogram
+kernel (interpret mode), partition column extraction, and the
+tpu_sparse_kernel training plumbing.
+
+Reference semantics matched: OrderedSparseBin's nonzero-only histogram
+iteration (src/io/ordered_sparse_bin.hpp:26-209) with FixHistogram
+fill-slot reconstruction (src/treelearner/feature_histogram.hpp:904-941).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.sparse_mxu import (build_chunked_store,
+                                         chunked_child_hists_ref,
+                                         chunked_split_column,
+                                         sparse_wave_histogram_mxu)
+
+
+def _sparse_data(n=5000, f=13, b=14, L=12, seed=0, dense_col=3,
+                 empty_col=7):
+    rng = np.random.default_rng(seed)
+    fill = rng.integers(0, b, size=f)
+    X = np.tile(fill, (n, 1)).astype(np.uint8)
+    nz = rng.random((n, f)) < 0.15
+    X[nz] = rng.integers(0, b, size=int(nz.sum())).astype(np.uint8)
+    if dense_col is not None:       # per-column skew: one dense column
+        X[:, dense_col] = rng.integers(0, b, size=n).astype(np.uint8)
+    if empty_col is not None:       # and one all-fill column
+        X[:, empty_col] = fill[empty_col]
+    leaf_id = rng.integers(0, L, size=n).astype(np.int32)
+    w3 = rng.normal(size=(n, 3)).astype(np.float32)
+    return X, fill, leaf_id, w3
+
+
+def _dense_oracle(X, fill, leaf_id, w3, cid, b):
+    """(K, F, B, 3) histogram with fill slots zeroed (the store never
+    materializes fill entries; the view reconstructs them)."""
+    k, f = len(cid), X.shape[1]
+    out = np.zeros((k, f, b, 3))
+    oh = np.stack([(X == bb) for bb in range(b)], axis=-1)  # (N, F, B)
+    for kk, c in enumerate(cid):
+        if c < 0:
+            continue
+        m = (leaf_id == c).astype(np.float64)
+        out[kk] = np.einsum("nfb,nc->fbc", oh, w3 * m[:, None])
+    for j in range(f):
+        out[:, j, fill[j], :] = 0.0
+    return out
+
+
+def test_store_roundtrip():
+    X, fill, _, _ = _sparse_data()
+    store, cap, nbytes = build_chunked_store(X, fill, 14, entry_chunk=128,
+                                             chunk_block=4)
+    n, f = X.shape
+    # reconstruct the dense matrix from the store
+    dense = np.tile(fill, (n, 1)).astype(np.int64)
+    rows = np.asarray(store.ent_row).reshape(-1)
+    bins = np.asarray(store.ent_bin).reshape(-1)
+    cols = np.repeat(np.asarray(store.chunk_col)[:, 0], 128)
+    ok = rows < n
+    dense[rows[ok], cols[ok]] = bins[ok]
+    np.testing.assert_array_equal(dense, X.astype(np.int64))
+    assert store.ent_bin.shape[0] % 4 == 0
+    assert cap >= 1 and nbytes > 0
+
+
+@pytest.mark.parametrize("entry_chunk", [128, 256])
+def test_segment_oracle_matches_dense(entry_chunk):
+    b, L = 14, 12
+    X, fill, leaf_id, w3 = _sparse_data(b=b, L=L)
+    store, cap, _ = build_chunked_store(X, fill, b,
+                                        entry_chunk=entry_chunk)
+    cid = np.array([0, 2, 4, -1, 7], np.int32)
+    got = chunked_child_hists_ref(store, jnp.asarray(leaf_id),
+                                  jnp.asarray(w3), jnp.asarray(cid), b,
+                                  X.shape[1], L)
+    want = _dense_oracle(X, fill, leaf_id, w3, cid, b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_kernel_interpret_matches_dense():
+    b, L = 14, 12
+    X, fill, leaf_id, w3 = _sparse_data(b=b, L=L)
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128,
+                                        chunk_block=4)
+    cid = np.array([0, 2, 4, -1, 7], np.int32)
+    got = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                    jnp.asarray(w3), jnp.asarray(cid), b,
+                                    X.shape[1], interpret=True)
+    want = _dense_oracle(X, fill, leaf_id, w3, cid, b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+
+
+def test_kernel_nondefault_chunk_block():
+    """A store padded to a chunk_block that is NOT a multiple of the
+    kernel's CHUNK_BLOCK still runs (the grid step divides nc exactly)."""
+    b, L = 14, 12
+    X, fill, leaf_id, w3 = _sparse_data(n=1200, f=9, b=b, L=L, seed=3)
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128,
+                                        chunk_block=1)
+    nc = store.ent_bin.shape[0]
+    cid = np.array([1, 3, -1], np.int32)
+    got = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                    jnp.asarray(w3), jnp.asarray(cid), b,
+                                    X.shape[1], interpret=True)
+    want = _dense_oracle(X, fill, leaf_id, w3, cid, b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+
+
+def test_kernel_root_slot_call():
+    """The wave engine's root call: slot 0 = leaf 0, other slots -1."""
+    b, L = 10, 8
+    X, fill, leaf_id, w3 = _sparse_data(n=2000, f=5, b=b, L=1,
+                                        dense_col=None, empty_col=None)
+    leaf_id[:] = 0
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128)
+    cid = np.full(4, -1, np.int32)
+    cid[0] = 0
+    got = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                    jnp.asarray(w3), jnp.asarray(cid), b,
+                                    X.shape[1], interpret=True)
+    want = _dense_oracle(X, fill, leaf_id, w3, cid, b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+    assert np.all(np.asarray(got)[1:] == 0.0)
+
+
+def test_split_column_extraction():
+    b = 14
+    X, fill, _, _ = _sparse_data(b=b)
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128)
+    n, f = X.shape
+    for j in [0, 3, 7, f - 1]:
+        col = chunked_split_column(store, jnp.asarray(j), n, cap)
+        np.testing.assert_array_equal(np.asarray(col),
+                                      X[:, j].astype(np.int32))
+
+
+def test_train_sparse_kernel_matches_sparse():
+    """tpu_sparse_kernel=true trains through the chunked store (CPU
+    fallback = the segment oracle) and matches plain tpu_sparse wave
+    growth tree for tree."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(5)
+    n = 3000
+    X = np.where(rng.random((n, 12)) < 0.1,
+                 rng.normal(size=(n, 12)), 0.0)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "tpu_sparse": True}
+    pk = dict(base, tpu_sparse_kernel=True)
+    pw = dict(base, tpu_growth="wave")
+    bk = lgb.train(pk, lgb.Dataset(X, label=y, params=pk),
+                   num_boost_round=5)
+    bw = lgb.train(pw, lgb.Dataset(X, label=y, params=pw),
+                   num_boost_round=5)
+    assert bk._gbdt.learner.growth == "wave"
+    assert bk._gbdt.learner.hist_mode == "sparse_mxu"
+    np.testing.assert_allclose(bk.predict(X), bw.predict(X), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sparse_kernel_reset_parameters():
+    """reset_config under the chunked store: the reuse path must accept
+    a ChunkedSparseStore (gbdt.reset_config) and keep training."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(7)
+    n = 1500
+    X = np.where(rng.random((n, 8)) < 0.12, rng.normal(size=(n, 8)), 0.0)
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "tpu_sparse": True, "tpu_sparse_kernel": True}
+    bst = lgb.train(
+        params, lgb.Dataset(X, label=y, params=params),
+        num_boost_round=4,
+        callbacks=[lgb.reset_parameter(
+            learning_rate=lambda i: 0.1 * (0.9 ** i))])
+    assert bst._gbdt.learner.hist_mode == "sparse_mxu"
+    assert bst.predict(X).shape == (n,)
+
+
+def test_sparse_kernel_exact_growth_rejected():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "tpu_sparse": True, "tpu_sparse_kernel": True,
+              "tpu_growth": "exact"}
+    with pytest.raises(LightGBMError):
+        lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                  num_boost_round=1)

@@ -177,6 +177,17 @@ R03E = [
     ("bosch1Mx968 dense wave64",
      {"kind": "sparse", "n": 1_000_000, "width": 64, "timeout": 2700,
       "extra": {"tpu_growth": "wave"}}),
+    # entry-chunk MXU sparse kernel (ops/sparse_mxu.py, round 4): the
+    # O(nnz) histogram economics of the coordinate store WITHOUT the
+    # segment_sum scatter — per-chunk (Bp, E) x (E, 3K) contractions.
+    # Expected HBM floor ~20 B/nnz per pass vs the dense wave's
+    # 968 B/row bin-matrix read.
+    ("bosch1Mx968 sparse_mxu wave32",
+     {"kind": "sparse", "n": 1_000_000, "width": 32, "timeout": 2700,
+      "extra": {"tpu_sparse": True, "tpu_sparse_kernel": True}}),
+    ("bosch1Mx968 sparse_mxu wave8",
+     {"kind": "sparse", "n": 1_000_000, "width": 8, "timeout": 2700,
+      "extra": {"tpu_sparse": True, "tpu_sparse_kernel": True}}),
 ]
 
 R03B = [
