@@ -255,8 +255,10 @@ class GBDT:
         when bin thresholds exist, raw-data fallback for loaded models)."""
         if tree.num_leaves <= 1:
             return
+        from ..ops.sparse_mxu import ChunkedSparseStore
         from ..ops.sparse_store import SparseDeviceStore
-        sparse_store = isinstance(self.learner.X, SparseDeviceStore)
+        sparse_store = isinstance(self.learner.X,
+                                  (SparseDeviceStore, ChunkedSparseStore))
         if tree.has_bin_thresholds and not sparse_store:
             ta = dev_predict.traversal_from_host_tree(tree, self.score_dtype)
             self._score_dev = self._score_dev.at[tid].set(

@@ -274,9 +274,11 @@ class SerialTreeLearner:
         # engine on one device still gets the sparse store.  The
         # feature-parallel subclass is the exception — it calls this
         # ctor with psum_axis=None but a pre-sharded dense device_data.
+        from .sparse_mxu import ChunkedSparseStore as _ChStore
         true_serial = (psum_axis is None
                        and (device_data is None
-                            or isinstance(device_data, _SpStore)))
+                            or isinstance(device_data,
+                                          (_SpStore, _ChStore))))
         # the data-parallel learner shards the coordinate store by row
         # blocks itself (parallel/mesh.py); feature/voting keep dense
         dp_learner = (psum_axis is not None

@@ -264,6 +264,31 @@ def test_tile_plan_block_legality():
                 assert _bin_pad(64) % bsub == 0
 
 
+def test_kernel_wide_shape_epsilon_width():
+    """F=2000 (epsilon's width): the widest headline shape runs the
+    transposed kernel end-to-end in interpret mode.  Pins the wide-fc
+    tile plan + output reshape path that the on-chip epsilon failure
+    exposed (tools/ab_err_suite_epsilon.log); auto resolves epsilon to
+    pallas_t (49 MB hist block < the 64 MB gate), so this is the shape's
+    production kernel."""
+    rng = np.random.default_rng(0)
+    n, f, b, k = 512, 2000, 63, 8
+    X = rng.integers(0, b, size=(n, f), dtype=np.uint8)
+    lid = rng.integers(0, 16, size=n).astype(np.int32)
+    w3 = rng.normal(size=(n, 3)).astype(np.float32)
+    cid = np.arange(k, dtype=np.int32)
+    cid[5] = -1
+    got = wave_histogram_pallas_t(jnp.asarray(X.T), jnp.asarray(lid),
+                                  jnp.asarray(w3), jnp.asarray(cid), b,
+                                  interpret=True)
+    want = np.array(wave_histogram_reference(
+        jnp.asarray(X), jnp.asarray(lid), jnp.asarray(w3),
+        jnp.asarray(cid), b))
+    want[cid < 0] = 0
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+
+
 def _compact_from_tbl(tbl, w):
     """(cols (W,10), psrc (W,)) compact operands from a dense (L,10)
     table — active rows scatter into slots, the rest get psrc=-3."""

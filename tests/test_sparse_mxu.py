@@ -176,12 +176,32 @@ def test_sparse_kernel_reset_parameters():
     y = (X[:, 0] > 0).astype(np.float64)
     params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
               "tpu_sparse": True, "tpu_sparse_kernel": True}
+    # a non-learning_rate key: learning_rate-only resets take the
+    # shrinkage fast path and never reach gbdt.reset_config
     bst = lgb.train(
         params, lgb.Dataset(X, label=y, params=params),
         num_boost_round=4,
         callbacks=[lgb.reset_parameter(
-            learning_rate=lambda i: 0.1 * (0.9 ** i))])
+            lambda_l2=lambda i: 0.01 * (i + 1))])
     assert bst._gbdt.learner.hist_mode == "sparse_mxu"
+    assert bst.predict(X).shape == (n,)
+
+
+def test_sparse_kernel_dart_tree_ops():
+    """DART's drop/rescale path calls _apply_tree_to_train, which must
+    take the raw-data fallback for the chunked store (not slice the
+    NamedTuple as a dense matrix)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(9)
+    n = 1200
+    X = np.where(rng.random((n, 8)) < 0.12, rng.normal(size=(n, 8)), 0.0)
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "boosting": "dart", "num_leaves": 7,
+              "verbose": -1, "drop_rate": 0.9, "tpu_sparse": True,
+              "tpu_sparse_kernel": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=5)
     assert bst.predict(X).shape == (n,)
 
 
