@@ -24,6 +24,12 @@ def main():
     outdir = args[1] if len(args) > 1 else "/tmp/tpu_trace"
 
     import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # the env var alone does NOT override the axon TPU platform;
+        # the explicit config update before backend init does (the
+        # bench.py / tests/conftest.py trick) — without this a
+        # "CPU-only" invocation would silently hit the real chip
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from tools.bench_modes import make_data
     import lightgbm_tpu as lgb
 

@@ -1,0 +1,91 @@
+"""Summarize a jax.profiler trace directory into a ranked op-time table.
+
+There is no TensorBoard/Perfetto UI in this image, so the flagship
+residue analysis (ROADMAP.md: ~130 ms/wave outside the histogram
+kernel) needs a programmatic reader.  jax.profiler.trace() writes a
+Perfetto-format ``*.trace.json.gz`` under
+``<outdir>/plugins/profile/<run>/``; this tool aggregates complete
+('ph' == 'X') events per track, ranks device-side op time, and prints
+the top offenders plus per-track totals.
+
+Usage:  python tools/trace_summary.py /tmp/tpu_trace_1m [top_n]
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_events(trace_dir):
+    pats = [os.path.join(trace_dir, "plugins", "profile", "*",
+                         "*.trace.json.gz"),
+            os.path.join(trace_dir, "**", "*.trace.json.gz")]
+    paths = []
+    for p in pats:
+        paths = sorted(glob.glob(p, recursive=True))
+        if paths:
+            break
+    if not paths:
+        raise SystemExit("no *.trace.json.gz under %s" % trace_dir)
+    path = paths[-1]                      # newest run
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    return path, data.get("traceEvents", [])
+
+
+def main():
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+
+    path, events = load_events(trace_dir)
+    # pid/tid -> human-readable track names from metadata events
+    proc = {}
+    thread = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc[e.get("pid")] = e["args"].get("name", "")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread[(e.get("pid"), e.get("tid"))] = e["args"].get("name", "")
+
+    per_track = collections.Counter()          # track -> total us
+    per_op = collections.defaultdict(lambda: [0.0, 0])   # (track, op) -> [us, n]
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid, tid = e.get("pid"), e.get("tid")
+        track = proc.get(pid, str(pid))
+        tname = thread.get((pid, tid), "")
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        key = "%s/%s" % (track, tname) if tname else track
+        per_track[key] += dur
+        per_op[(key, name)][0] += dur
+        per_op[(key, name)][1] += 1
+
+    print("trace: %s" % path)
+    print("\n== total busy time per track (ms) ==")
+    for track, us in per_track.most_common(12):
+        print("  %10.2f  %s" % (us / 1e3, track))
+
+    # rank ops on device-ish tracks (XLA Ops / TensorFlow Op / stream
+    # tracks); fall back to all tracks if nothing matches
+    def devicey(track):
+        t = track.lower()
+        return ("xla op" in t or "tensorflow op" in t or "/device" in t
+                or "tpu" in t.split("/")[0] or "stream" in t)
+
+    rows = [(v[0], v[1], tr, op) for (tr, op), v in per_op.items()
+            if devicey(tr)]
+    if not rows:
+        rows = [(v[0], v[1], tr, op) for (tr, op), v in per_op.items()]
+    rows.sort(reverse=True)
+    print("\n== top %d ops by total time ==" % top_n)
+    print("  %10s %8s  %s" % ("total_ms", "count", "op [track]"))
+    for us, n, tr, op in rows[:top_n]:
+        print("  %10.2f %8d  %s  [%s]" % (us / 1e3, n, op[:100], tr[:60]))
+
+
+if __name__ == "__main__":
+    main()
