@@ -233,7 +233,19 @@ class SerialTreeLearner:
                                    resolve_wave_order(config))
                                if on_tpu and wave_capable else 0)
             if on_tpu and wave_capable and vmem_hist_bytes <= 64 << 20:
-                hist_mode = "pallas_t"
+                # v5 fused kernel promotion (round-4 on-chip A/Bs): at
+                # the narrow-F recipe pallas_ct beats pallas_t at BOTH
+                # measured shapes — 1.30 vs 1.16 it/s at the 10.5M x 28
+                # flagship (tools/BENCH_SUITE.md higgs_ct) and 11.66 vs
+                # 10.92 at 1M x 28 (tools/AB_RESULTS.md) — by fusing the
+                # partition sweep into the histogram kernel (ONE Xt read
+                # per wave).  Wide-F shapes keep pallas_t until ct has
+                # on-chip datapoints there (epsilon/msltr ct arms are
+                # queued; the forced-W=16 epsilon pathology shows wide-F
+                # cells can surprise, BENCH_NOTES.md).
+                hist_mode = ("pallas_ct"
+                             if ncols * _bin_pad(nbins) <= 2048
+                             else "pallas_t")
             else:
                 hist_mode = "onehot" if on_tpu else "scatter"
         self.hist_mode = hist_mode

@@ -328,8 +328,10 @@ class Config:
         # per-leaf kernel, 'pallas_t' = wave kernel with MXU-native
         # transposed operands, 'pallas_ct' = fused partition+histogram
         # wave kernel, compact split table, one read of X_t per wave).
-        # auto = pallas_t on TPU when the wave engine runs it (f32,
-        # dense, serial/data learner; measured fastest on v5e), else
+        # auto, on TPU when the wave engine runs it (f32, dense,
+        # serial/data learner): pallas_ct for narrow shapes
+        # (ncols * bin_pad <= 2048 — measured winner at 10.5M x 28 and
+        # 1M x 28, r4), pallas_t for wider VMEM-feasible shapes; else
         # onehot on TPU, scatter elsewhere.  (pallas_f/pallas_ft were
         # deleted in r4: lost every on-chip A/B, padded-operand OOM
         # liability — tools/AB_RESULTS.md.)

@@ -127,8 +127,10 @@ def _route_numpy(X, leaf_id, tbl, bundled=False):
 
 def test_auto_hist_mode_resolution(monkeypatch):
     """tpu_histogram_mode=auto picks the measured winner per backend:
-    pallas_t on TPU when the wave engine will run it; onehot on TPU
-    otherwise; scatter on CPU (tools/AB_RESULTS.md)."""
+    on TPU, when the wave engine will run it, pallas_ct for narrow
+    shapes (ncols * bin_pad <= 2048) and pallas_t for wider
+    VMEM-feasible ones; onehot on TPU otherwise; scatter on CPU
+    (tools/AB_RESULTS.md, tools/BENCH_SUITE.md higgs_ct)."""
     import jax
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ops.learner import SerialTreeLearner
@@ -155,8 +157,21 @@ def test_auto_hist_mode_resolution(monkeypatch):
     from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
     make_wave_core.cache_clear(); make_wave_jit.cache_clear()
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert learner_for().hist_mode == "pallas_t"
+    # narrow-F under the fused-kernel bound (5 cols * 256-pad = 1280
+    # <= 2048): the round-4 promoted pallas_ct (measured winner at
+    # 10.5M x 28 and 1M x 28 — learner.py auto block)
+    assert learner_for().hist_mode == "pallas_ct"
     assert learner_for(tpu_growth="exact").hist_mode == "onehot"
+    # wider than the fused-kernel bound but inside the VMEM gate: the
+    # measured pallas_t stays (40 cols * 64-pad = 2560 > 2048; a broken
+    # bound silently shipping the unmeasured ct kernel to epsilon/msltr
+    # -class shapes must fail here)
+    Xm = rng.normal(size=(600, 40))
+    ym = (Xm[:, 0] > 0).astype(np.float64)
+    cfgm = Config({"objective": "binary", "num_leaves": 7,
+                   "max_bin": 63, "verbose": -1})
+    tdm = TrainingData.from_matrix(Xm, label=ym, config=cfgm)
+    assert SerialTreeLearner(cfgm, tdm).hist_mode == "pallas_t"
     assert learner_for(tpu_use_dp=True).hist_mode == "onehot"
     sp = learner_for(tpu_sparse=True)
     assert sp.hist_mode == "sparse"    # sparse store keeps its own path

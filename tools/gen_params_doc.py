@@ -76,10 +76,12 @@ NOTES = {
                        "(bit-identical trees, ~L/W less lookup traffic). "
                        "auto: compact on TPU, onehot elsewhere",
     "tpu_histogram_mode": "auto / onehot / scatter / pallas / pallas_t / "
-                          "pallas_ct histogram kernels; auto = "
-                          "pallas_t on TPU under the wave engine (f32, "
-                          "dense, serial/data), else onehot (TPU) / "
-                          "scatter",
+                          "pallas_ct histogram kernels; auto on TPU "
+                          "under the wave engine (f32, dense, "
+                          "serial/data) = pallas_ct for narrow shapes "
+                          "(ncols x bin-pad <= 2048), pallas_t for "
+                          "wider VMEM-feasible ones, else onehot (TPU) "
+                          "/ scatter",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
