@@ -348,6 +348,13 @@ class SerialTreeLearner:
         self.wave_width = (resolve_wave_width(config, self.num_leaves,
                                               self.wave_order)
                            if growth == "wave" else 1)
+        hp = str(config.tpu_hist_precision).strip().lower()
+        if hp not in ("auto", "hilo", "bf16"):
+            Log.fatal("Unknown tpu_hist_precision %s (expected auto/"
+                      "hilo/bf16)", config.tpu_hist_precision)
+        # applies only where the Pallas wave kernels run; 'auto' stays
+        # on the exact hi/lo split (quality-first default)
+        self.hist_hilo = hp != "bf16"
         lk = str(config.tpu_wave_lookup).strip().lower()
         # validate unconditionally (like tpu_histogram_mode): a typo'd
         # value must not be silently ignored just because growth resolved
@@ -519,7 +526,7 @@ class SerialTreeLearner:
                 self.cache_hists, hist_mode,
                 int(config.tpu_wave_chunk), self.packed_cols,
                 self.sparse_col_cap, self.wave_order == "exact",
-                self.wave_lookup)
+                self.wave_lookup, self.hist_hilo)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;

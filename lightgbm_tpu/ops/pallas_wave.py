@@ -58,28 +58,40 @@ def _tile_plan(n, fc, bp, row_tile):
     return bsub, c
 
 
-def _hi_lo(wmat):
-    """Exact bf16 hi/lo split of an f32 weight matrix (any orientation).
+def _round_bf16(wmat):
+    """Round-to-nearest f32 -> bf16 in bit arithmetic (Mosaic's cast
+    TRUNCATES — measured: biased sums ~100x above round-to-nearest
+    theory — so the rounding must be done manually)."""
+    return pltpu.bitcast(
+        (pltpu.bitcast(wmat, jnp.uint32) + jnp.uint32(0x8000))
+        & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
 
-    Mantissa truncation — a bf16 round-trip would be folded to identity
-    under --xla_allow_excess_precision, silently zeroing the residual
-    term (observed on v5e).  The residual is scaled by 2^8 (exact) into
-    bf16 range; Mosaic's f32->bf16 cast TRUNCATES (measured: biased sums
-    ~100x above round-to-nearest theory), so it is rounded manually in
-    bit arithmetic first.
+
+def _hi_lo(wmat, hilo=True):
+    """bf16 weight split for the MXU: exact hi/lo pair (default), or a
+    single round-to-nearest bf16 term (hilo=False — half the MXU work).
+
+    Mantissa truncation for the hi part — a bf16 round-trip would be
+    folded to identity under --xla_allow_excess_precision, silently
+    zeroing the residual term (observed on v5e).  The residual is scaled
+    by 2^8 (exact) into bf16 range and rounded manually (see
+    _round_bf16).  The single-term mode is the reference GPU's
+    single-precision-histogram trade
+    (docs/GPU-Performance.md:127-130, gpu_use_dp=false default): ~2^-9
+    relative product error instead of ~2^-17, f32 accumulation either
+    way.
     """
+    if not hilo:
+        return _round_bf16(wmat), None
     wh_f32 = pltpu.bitcast(
         pltpu.bitcast(wmat, jnp.uint32) & jnp.uint32(0xFFFF0000),
         jnp.float32)
     wh = wh_f32.astype(jnp.bfloat16)                 # exact: mantissa fits
     wl_f32 = (wmat - wh_f32) * jnp.float32(256.0)
-    wl = pltpu.bitcast(
-        (pltpu.bitcast(wl_f32, jnp.uint32) + jnp.uint32(0x8000))
-        & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
-    return wh, wl
+    return wh, _round_bf16(wl_f32)
 
 
-def _split_weights_t(lid_ref, w3_ref, cid_ref):
+def _split_weights_t(lid_ref, w3_ref, cid_ref, hilo=True):
     """Per-child masked weights in the ROW-VECTOR orientation: (3K, Cg)
     bf16 hi/lo from lid (1, Cg), w3 (3, Cg), cid (K, 1).
 
@@ -93,7 +105,7 @@ def _split_weights_t(lid_ref, w3_ref, cid_ref):
     match = (cid_ref[:] == lid_ref[:]).astype(jnp.float32)   # (K, Cg)
     wmat = jnp.concatenate(
         [match * w3_ref[ch:ch + 1, :] for ch in range(3)], axis=0)
-    return _hi_lo(wmat)                                      # (3K, Cg)
+    return _hi_lo(wmat, hilo)                                # (3K, Cg)
 
 
 def _unpack4_t(xti, fc):
@@ -119,15 +131,16 @@ def _accum_hist(out_ref, xr, base, wh, wl, *, bp, fc, bsub, dims):
         acc = jax.lax.dot_general(
             oh, wh, dimension_numbers=dims,
             preferred_element_type=jnp.float32)          # (bsub*Fc, 3K)
-        acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
-            oh, wl, dimension_numbers=dims,
-            preferred_element_type=jnp.float32)
+        if wl is not None:
+            acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
+                oh, wl, dimension_numbers=dims,
+                preferred_element_type=jnp.float32)
         rows = slice(s * bsub * fc, (s + 1) * bsub * fc)
         out_ref[rows, :] = out_ref[rows, :] + acc
 
 
 def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
-                      *, bp, fc, k, bsub, packed):
+                      *, bp, fc, k, bsub, packed, hilo=True):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -146,7 +159,7 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
     # child match + channel-major weights, built in VMEM — nothing
     # per-wave crosses HBM beyond X/leaf_id/w3 themselves
-    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref)  # (3K, Cg)
+    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref, hilo)  # (3K, Cg)
 
     # bins [s*bsub, (s+1)*bsub) x all features, bin-major columns.
     # f32 select then downcast: the i1 result carries f32 (8,128)
@@ -159,10 +172,11 @@ def _wave_hist_kernel(x_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
-                                             "interpret", "logical_cols"))
+                                             "interpret", "logical_cols",
+                                             "hilo"))
 def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
                           row_tile: int = 8192, interpret: bool = False,
-                          logical_cols: int = 0):
+                          logical_cols: int = 0, hilo: bool = True):
     """(K, F, B, 3) histograms of the rows whose leaf is child_id[k].
 
     X: (N, F) uint8/int bin ids;  leaf_id: (N,) int32 (already partitioned);
@@ -193,7 +207,8 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     nch = (n + pad) // c
 
     kernel = functools.partial(_wave_hist_kernel, bp=bp, fc=fc, k=k,
-                               bsub=bsub, packed=bool(logical_cols))
+                               bsub=bsub, packed=bool(logical_cols),
+                               hilo=hilo)
     flat = pl.pallas_call(
         kernel,
         grid=(nch,),
@@ -237,7 +252,7 @@ def wave_histogram_reference(X, leaf_id, w3, child_id, num_bins: int):
 # --------------------------------------------------------------------------
 
 def _wave_hist_kernel_t(xt_ref, lid_ref, w3_ref, cid_ref, out_ref,
-                        *, bp, fc, k, bsub, packed):
+                        *, bp, fc, k, bsub, packed, hilo=True):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -250,7 +265,7 @@ def _wave_hist_kernel_t(xt_ref, lid_ref, w3_ref, cid_ref, out_ref,
     xt = xi.astype(jnp.float32)                      # (Fc, Cg)
     cg = xt.shape[1]
 
-    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref)  # (3K, Cg) hi/lo
+    wh, wl = _split_weights_t(lid_ref, w3_ref, cid_ref, hilo)  # (3K, Cg)
 
     xr = pltpu.repeat(xt, bsub, axis=0)              # (bsub*Fc, Cg) tiled
     base = (jax.lax.broadcasted_iota(jnp.int32, (bsub * fc, cg), 0)
@@ -260,10 +275,11 @@ def _wave_hist_kernel_t(xt_ref, lid_ref, w3_ref, cid_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
-                                             "interpret", "logical_cols"))
+                                             "interpret", "logical_cols",
+                                             "hilo"))
 def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
                             row_tile: int = 8192, interpret: bool = False,
-                            logical_cols: int = 0):
+                            logical_cols: int = 0, hilo: bool = True):
     """Same contract as wave_histogram_pallas, but takes the TRANSPOSED bin
     matrix X_t (F, N) (packed: (ceil(F/2), N) with logical_cols set)."""
     fdev, n = X_t.shape
@@ -282,7 +298,8 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
     nch = (n + pad) // c
 
     kernel = functools.partial(_wave_hist_kernel_t, bp=bp, fc=fc, k=k,
-                               bsub=bsub, packed=bool(logical_cols))
+                               bsub=bsub, packed=bool(logical_cols),
+                               hilo=hilo)
     flat = pl.pallas_call(
         kernel,
         grid=(nch,),
@@ -338,7 +355,8 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
 
 def _wave_fused_kernel_ct(xt_ref, lid_ref, w3_ref, cid_ref, tblt_ref,
                           psrc_ref, lid_out_ref, out_ref,
-                          *, bp, fc, k, bsub, packed, bundled):
+                          *, bp, fc, k, bsub, packed, bundled,
+                          hilo=True):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -386,7 +404,7 @@ def _wave_fused_kernel_ct(xt_ref, lid_ref, w3_ref, cid_ref, tblt_ref,
 
     # ---- histograms from the UPDATED ids (v2 layout: (3K, Cg) weights;
     # the shared helper accepts any (1, Cg) row, not just a ref)
-    wh, wl = _split_weights_t(new_lid, w3_ref, cid_ref)        # (3K, Cg)
+    wh, wl = _split_weights_t(new_lid, w3_ref, cid_ref, hilo)  # (3K, Cg)
 
     xt = xint.astype(jnp.float32)
     xr = pltpu.repeat(xt, bsub, axis=0)              # (bsub*Fc, Cg)
@@ -398,12 +416,13 @@ def _wave_fused_kernel_ct(xt_ref, lid_ref, w3_ref, cid_ref, tblt_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "bundled",
                                              "row_tile", "interpret",
-                                             "logical_cols"))
+                                             "logical_cols", "hilo"))
 def wave_partition_hist_pallas_ct(X_t, leaf_id, w3, child_id, cols, psrc,
                                   num_bins: int, bundled: bool = False,
                                   row_tile: int = 8192,
                                   interpret: bool = False,
-                                  logical_cols: int = 0):
+                                  logical_cols: int = 0,
+                                  hilo: bool = True):
     """Fused wave step from the transposed matrix alone.
 
     X_t: (F, N) bins (packed: (ceil(F/2), N) with logical_cols);
@@ -430,7 +449,7 @@ def wave_partition_hist_pallas_ct(X_t, leaf_id, w3, child_id, cols, psrc,
 
     kernel = functools.partial(_wave_fused_kernel_ct, bp=bp, fc=fc, k=k,
                                bsub=bsub, packed=bool(logical_cols),
-                               bundled=bundled)
+                               bundled=bundled, hilo=hilo)
     newlid, flat = pl.pallas_call(
         kernel,
         grid=(nch,),

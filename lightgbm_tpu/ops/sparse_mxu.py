@@ -107,7 +107,7 @@ def build_chunked_store(binned: np.ndarray, fill: np.ndarray,
 
 
 def _chunk_hist_kernel(bin_ref, lid_ref, g_ref, h_ref, m_ref, cid_ref,
-                       colv_ref, out_ref, *, bp, gc):
+                       colv_ref, out_ref, *, bp, gc, hilo=True):
     """One grid step: gc chunks, each one (Bp, E) x (E, 3K) contraction
     accumulated into its column's row block of the (F*Bp, 3K) output."""
     from jax.experimental import pallas as pl
@@ -127,7 +127,7 @@ def _chunk_hist_kernel(bin_ref, lid_ref, g_ref, h_ref, m_ref, cid_ref,
         wmat = jnp.concatenate(
             [match * g_ref[g:g + 1, :], match * h_ref[g:g + 1, :],
              match * m_ref[g:g + 1, :]], axis=0)               # (3K, E)
-        wh, wl = _hi_lo(wmat)
+        wh, wl = _hi_lo(wmat, hilo)
         e = binrow.shape[1]
         iota = jax.lax.broadcasted_iota(
             jnp.int32, (bp, e), 0).astype(jnp.float32)
@@ -136,19 +136,20 @@ def _chunk_hist_kernel(bin_ref, lid_ref, g_ref, h_ref, m_ref, cid_ref,
         acc = jax.lax.dot_general(                             # A @ B^T
             oh, wh, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                # (Bp, 3K)
-        acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
-            oh, wl, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if wl is not None:
+            acc = acc + jnp.float32(1.0 / 256.0) * jax.lax.dot_general(
+                oh, wl, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
         col = colv_ref[g, 0]
         rows = pl.dslice(col * bp, bp)
         out_ref[rows, :] = out_ref[rows, :] + acc
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
-                                             "interpret"))
+                                             "interpret", "hilo"))
 def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
                               child_id, num_bins: int, num_cols: int,
-                              interpret: bool = False):
+                              interpret: bool = False, hilo: bool = True):
     """(K, F, B, 3) histograms of the rows whose leaf is child_id[k],
     from nonzero entries only (fill slots zero — view reconstructs).
 
@@ -175,7 +176,7 @@ def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
     h_e = jnp.take(w3f[:, 1], rows_flat, mode="clip").reshape(nc, e)
     m_e = jnp.take(w3f[:, 2], rows_flat, mode="clip").reshape(nc, e)
 
-    kernel = functools.partial(_chunk_hist_kernel, bp=bp, gc=gc)
+    kernel = functools.partial(_chunk_hist_kernel, bp=bp, gc=gc, hilo=hilo)
     flat = pl.pallas_call(
         kernel,
         grid=(nc // gc,),

@@ -108,7 +108,7 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       hist_mode: str = "onehot", chunk: int = 16384,
                       packed_cols: int = 0, sparse_col_cap: int = 0,
                       with_xt: bool = False, exact_order: bool = False,
-                      lookup: str = "onehot"):
+                      lookup: str = "onehot", hist_hilo: bool = True):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id)).
@@ -122,7 +122,7 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
                           hist_mode, chunk, packed_cols, sparse_col_cap,
-                          exact_order, lookup)
+                          exact_order, lookup, hist_hilo)
 
     if with_xt:
         def grow(X, grad, hess, row_mult, feature_mask, Xt):
@@ -150,7 +150,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    psum_axis: str, has_bundle: bool, group_bins: int,
                    cache_hists: bool, hist_mode: str, chunk: int,
                    packed_cols: int = 0, sparse_col_cap: int = 0,
-                   exact_order: bool = False, lookup: str = "onehot"):
+                   exact_order: bool = False, lookup: str = "onehot",
+                   hist_hilo: bool = True):
     """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
     byte) and packed_cols is the LOGICAL column count; every chunk is
     unpacked in-scan so the full-width matrix never hits HBM (the
@@ -254,7 +255,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 if (jax.default_backend() == "tpu"
                         and hist_dtype == jnp.float32):
                     return sparse_wave_histogram_mxu(
-                        X, lid, w3, cid, hist_bins, Fc)
+                        X, lid, w3, cid, hist_bins, Fc, hilo=hist_hilo)
                 return chunked_child_hists_ref(
                     X, lid, w3, cid, hist_bins, Fc, L)
             slot_tbl = jnp.full(L, -1, jnp.int32).at[
@@ -312,10 +313,12 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             if pallas_transposed:
                 from .pallas_wave import wave_histogram_pallas_t
                 return wave_histogram_pallas_t(Xt, lid, w3, cid, hist_bins,
-                                               logical_cols=packed_cols)
+                                               logical_cols=packed_cols,
+                                               hilo=hist_hilo)
             from .pallas_wave import wave_histogram_pallas
             return wave_histogram_pallas(X, lid, w3, cid, hist_bins,
-                                         logical_cols=packed_cols)
+                                         logical_cols=packed_cols,
+                                         hilo=hist_hilo)
 
         def wave_pass(leaf_id, tbl, cols, psrc, small_id, valid):
             """Partition + child histograms, fused into ONE chunked sweep.
@@ -348,7 +351,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                     Xt, leaf_id, w3,
                     jnp.where(valid, small_id, -1), cols, psrc,
                     hist_bins, bundled=has_bundle,
-                    logical_cols=packed_cols)
+                    logical_cols=packed_cols, hilo=hist_hilo)
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
                 else leaf_id.reshape(nch, c)
             wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3

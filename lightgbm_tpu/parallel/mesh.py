@@ -214,7 +214,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 chunk=int(config.tpu_wave_chunk),
                 sparse_col_cap=self.sparse_col_cap, with_xt=needs_xt,
                 exact_order=self.wave_order == "exact",
-                lookup=self.wave_lookup)
+                lookup=self.wave_lookup, hist_hilo=self.hist_hilo)
             if needs_xt:
                 self._Xt = jax.jit(
                     jnp.transpose,

@@ -133,7 +133,7 @@ PARAMETER_SET = {
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
-    "tpu_sparse_kernel",
+    "tpu_sparse_kernel", "tpu_hist_precision",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -366,6 +366,16 @@ class Config:
         # table directly.  auto -> compact on TPU (measured +12% over
         # onehot-lookup on v5e at the flagship recipe), onehot elsewhere.
         "tpu_wave_lookup": ("str", "auto"),
+        # 'auto' | 'hilo' | 'bf16' — MXU product precision of the Pallas
+        # wave histogram kernels.  'hilo' (exact bf16 hi+lo split, two
+        # dots, ~2^-17 relative products) is the quality-first default;
+        # 'bf16' (single round-to-nearest bf16 term, ~2^-9 products,
+        # f32 accumulation) HALVES the kernel's MXU work — the analog of
+        # the reference GPU's default single-precision histograms
+        # (docs/GPU-Performance.md:127-130, gpu_use_dp=false).  Split
+        # ROUTING is unaffected (exact f32 compares) — only histogram
+        # sums, and through them split choices, can drift.  auto = hilo.
+        "tpu_hist_precision": ("str", "auto"),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
         # (VMEM-residency vs scan-overhead tradeoff on TPU; engine

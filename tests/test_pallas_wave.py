@@ -42,6 +42,40 @@ def test_kernel_matches_oracle(layout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.parametrize("layout", ["v1", "v2", "v5"])
+def test_kernel_bf16_precision_mode(layout):
+    """tpu_hist_precision=bf16 (single round-to-nearest product, half the
+    MXU work — the reference GPU's gpu_use_dp=false analog): sums must
+    stay within the 2^-9-per-product class of the exact oracle, measured
+    against the histogram's scale (signed gradients make tiny individual
+    cells legitimately high-relative-error)."""
+    X, leaf_id, w3, cid, b = _data()
+    want = np.array(wave_histogram_reference(
+        jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
+        jnp.asarray(cid), b))
+    want[np.asarray(cid) < 0] = 0.0
+    if layout == "v1":
+        got = wave_histogram_pallas(
+            jnp.asarray(X), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(cid), b, interpret=True, hilo=False)
+    elif layout == "v2":
+        got = wave_histogram_pallas_t(
+            jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(cid), b, interpret=True, hilo=False)
+    else:
+        from lightgbm_tpu.ops.pallas_wave import (
+            wave_partition_hist_pallas_ct)
+        # inactive table: no splits commit, histograms of cid as-is
+        cols = np.zeros((4, 10), np.float32)
+        psrc = np.full(4, -3, np.int32)
+        _, got = wave_partition_hist_pallas_ct(
+            jnp.asarray(X.T), jnp.asarray(leaf_id), jnp.asarray(w3),
+            jnp.asarray(cid), jnp.asarray(cols), jnp.asarray(psrc), b,
+            interpret=True, hilo=False)
+    scale = np.abs(want).max()
+    assert np.abs(np.asarray(got) - want).max() <= 5e-3 * scale
+
+
 @pytest.mark.parametrize("mode", ["pallas_t", "pallas_ct"])
 def test_pallas_wave_data_parallel_constructs(mode):
     """tree_learner=data + a wave-only pallas mode must reach the mesh
@@ -149,6 +183,14 @@ def test_auto_hist_mode_resolution(monkeypatch):
 
     # CPU truth (this process): scatter
     assert learner_for().hist_mode == "scatter"
+
+    # tpu_hist_precision is validated unconditionally (like
+    # tpu_histogram_mode); bf16 resolves the kernels' hilo flag off
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        learner_for(tpu_hist_precision="f64")
+    assert learner_for(tpu_hist_precision="bf16").hist_hilo is False
+    assert learner_for(tpu_hist_precision="hilo").hist_hilo is True
 
     # fake the TPU backend: resolution must flip to pallas_t / onehot.
     # Clear the wave-core caches before AND after — cores built under the

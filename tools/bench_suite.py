@@ -83,6 +83,41 @@ SHAPES = {
         "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
         "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
         warmup=3, measured=10, timeout=2700),
+    # single-bf16-product histograms (tpu_hist_precision=bf16, the
+    # gpu_use_dp=false analog): the kernel is MXU-FLOP-bound (~71%
+    # utilization at the flagship, 13:17 trace), so halving the dots
+    # should land ~1.7-1.9x — quality delta vs the hi/lo arm decides
+    # whether it can ever be a default
+    "higgs_bf16": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32,
+        "tpu_hist_precision": "bf16"},
+        warmup=3, measured=10, timeout=2700),
+    # pallas_ct at the WIDE shapes (promotion widening: ct auto is
+    # currently gated to ncols*bin_pad <= 2048 — these arms supply the
+    # wide-F datapoints; the W=16-epsilon / W=32-bosch pathology says
+    # wide-F cells can surprise)
+    "epsilon_ct": dict(n=400_000, f=2000, cache_as="epsilon", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
+        warmup=2, measured=5, timeout=2700),
+    "msltr_ct": dict(n=2_270_000, f=137, cache_as="msltr", params={
+        "objective": "lambdarank", "metric": "ndcg", "ndcg_eval_at": "10",
+        "num_leaves": 255, "max_bin": 63, "learning_rate": 0.1,
+        "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
+        warmup=2, measured=5, timeout=2700, query_size=120),
+    # width probe at the yahoo shape: if its 7.06 s/iter sits in the
+    # same ~17-24 MB hist-block pathology band as epsilon-W16/bosch-W32,
+    # W=64 (34 MB block) should be sharply faster
+    "yahoo_w64": dict(n=473_134, f=700, cache_as="yahoo", params={
+        "objective": "lambdarank", "metric": "ndcg",
+        "ndcg_eval_at": "1,10", "num_leaves": 255, "max_bin": 63,
+        "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_wave_width": 64}, warmup=2, measured=5, timeout=2700,
+        query_size=23),
 }
 
 

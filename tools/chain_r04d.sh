@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 late-window deck, armed after the ~14:10 UTC re-wedge:
+# waits for the tunnel, then runs (1) the precision/ct-widening/width
+# suite arms, (2) the 1M bf16 kernel A/Bs, (3) the missing 10.5M
+# parity WAVE arm, (4) a final bench warm pass.  Budget-gated so the
+# chip is released well before the driver's round-end bench.
+cd /root/repo || exit 1
+LOG=/tmp/chain_r04.log
+log() { echo "[chain4d] $(date -u +%F\ %T) $*" >> "$LOG"; }
+
+END=${CHAIN4D_END_EPOCH:-$(( $(date +%s) + 25200 ))}
+left() { echo $(( END - $(date +%s) )); }
+
+stage() {  # stage <name> <cap_seconds> <cmd...>
+  local name=$1 cap=$2; shift 2
+  local l; l=$(left)
+  if [ "$l" -le 300 ]; then log "$name SKIPPED (budget spent)"; return; fi
+  [ "$cap" -gt "$l" ] && cap=$l
+  log "$name start (cap ${cap}s)"
+  timeout "$cap" "$@" ; log "$name rc=$?"
+}
+
+log "armed (end $(date -u -d @$END +%T))"
+while :; do
+  [ "$(left)" -le 600 ] && { log "tunnel never returned; idle-exit"; exit 0; }
+  timeout 150 python - <<'EOF' >/dev/null 2>&1 && break
+from lightgbm_tpu.utils.common import probe_device
+import sys
+sys.exit(0 if probe_device(timeout=120) == "tpu" else 1)
+EOF
+  sleep 120
+done
+log "tunnel ALIVE"
+
+stage suite2 9000 env SUITE_DEADLINE_S=8700 \
+  python tools/bench_suite.py higgs_bf16 epsilon_ct msltr_ct yahoo_w64
+
+stage ab2p 3600 env AB2_DEADLINE_S=3300 \
+  bash -c 'python tools/tpu_ab2.py 999424 --r04p > /tmp/ab2_r04p.out 2>&1'
+
+stage paritywave 3600 env PARITY_N=10500000 PARITY_DEADLINE_S=3300 \
+  bash -c 'python tools/parity_flagship.py --wave-only > /tmp/parity_fs10m_wave.out 2>&1'
+
+stage bench3 2100 env BENCH_DEADLINE_S=1800 \
+  bash -c 'python bench.py > /tmp/bench_r04_final.json 2> /tmp/bench_r04_final.err'
+
+log "chain4d complete; chip released"

@@ -82,6 +82,12 @@ NOTES = {
                           "(ncols x bin-pad <= 2048), pallas_t for "
                           "wider VMEM-feasible ones, else onehot (TPU) "
                           "/ scatter",
+    "tpu_hist_precision": "auto / hilo / bf16 — Pallas wave-kernel MXU "
+                          "product precision: hilo = exact bf16 hi+lo "
+                          "split (two dots); bf16 = single "
+                          "round-to-nearest term, half the MXU work "
+                          "(the reference GPU's single-precision "
+                          "histogram trade); auto = hilo",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "

@@ -204,8 +204,16 @@ def main():
         print(json.dumps({"ref_auc": ref_auc, "ref_spi": ref_spi}),
               flush=True)
         return
+    # --wave-only / --exact-only: re-run a single arm (e.g. after a
+    # tunnel wedge killed one of the pair — the ref arm and the other
+    # arm's committed row stay valid)
+    arms = ("exact", "wave")
+    if "--wave-only" in sys.argv:
+        arms = ("wave",)
+    elif "--exact-only" in sys.argv:
+        arms = ("exact",)
     rows = []
-    for growth in ("exact", "wave"):
+    for growth in arms:
         res = our_arm(growth, deadline)
         if res is None:
             rows.append((growth, None, None, None))

@@ -208,9 +208,27 @@ R03B = [
 ]
 
 
+R04P = [
+    # single-bf16-product histograms (tpu_hist_precision=bf16): the
+    # kernels are MXU-FLOP-bound, so dropping the lo dot should land
+    # ~1.7-1.9x per kernel; the paired AUCs vs the hi/lo cells above
+    # (11.66 ct / 10.43 t, auc=0.9357) gate any default change
+    ("pallas_ct W=32 bf16",
+     {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32,
+      "extra": {"tpu_hist_precision": "bf16"}}),
+    ("pallas_t  W=32 bf16",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
+      "extra": {"tpu_hist_precision": "bf16"}}),
+]
+
+
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if args else 999_424
+    if "--r04p" in sys.argv:
+        combos = [(name, dict(spec, n=n)) for name, spec in R04P]
+        run_combos(combos, n)
+        return
     if "--followup" in sys.argv:
         combos = [(name, dict(spec, n=n)) for name, spec in FOLLOWUP]
         run_combos(combos, n)
