@@ -95,6 +95,16 @@ class GBDT:
                                      training_metrics)
 
     # ----------------------------------------------------------------- setup
+    def _resolve_score_engine(self, config: Config) -> None:
+        se = str(config.tpu_score_update).strip().lower()
+        if se not in ("auto", "gather", "pallas"):
+            Log.fatal("Unknown tpu_score_update %s (expected auto/"
+                      "gather/pallas)", config.tpu_score_update)
+        # auto currently resolves to the XLA gather; the pallas
+        # compare-select kernel (ops/predict.py) flips in once its
+        # on-chip validation lands (ROADMAP.md round-4 notes)
+        self._score_engine = "gather" if se == "auto" else se
+
     def reset_config(self, config: Config) -> None:
         """GBDT::ResetConfig (gbdt.cpp:64-74): re-read training
         hyperparameters IN PLACE — training scores and the device-resident
@@ -108,6 +118,7 @@ class GBDT:
         self.config = config
         self.early_stopping_round = config.early_stopping_round
         self.shrinkage_rate = config.learning_rate
+        self._resolve_score_engine(config)
         from ..ops.learner import SerialTreeLearner
         from ..parallel.mesh import create_tree_learner
         old = self.learner
@@ -156,6 +167,7 @@ class GBDT:
         from ..parallel.mesh import create_tree_learner
         self.learner = create_tree_learner(config, train_data)
         self.score_dtype = self.learner.dtype
+        self._resolve_score_engine(config)
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
@@ -400,7 +412,8 @@ class GBDT:
                     dev_predict.update_score_from_partition(
                         self._score_dev[tid], leaf_id,
                         dev_tree.leaf_value,
-                        jnp.asarray(self.shrinkage_rate, self.score_dtype)))
+                        jnp.asarray(self.shrinkage_rate, self.score_dtype),
+                        engine=self._score_engine))
                 self._invalidate_train()
                 ta = dev_predict.traversal_from_grow(dev_tree)
                 scaled = ta._replace(leaf_value=ta.leaf_value)

@@ -129,11 +129,82 @@ def add_tree_to_score(score, X, tree: TraversalArrays, scale, layout=None,
 
 
 @jax.jit
-def update_score_from_partition(score, leaf_id, leaf_value, scale):
-    """Train-side score update via the learner's final partition
-    (score_updater.hpp:91-99): score += clip(scale * leaf_value)[leaf_id]."""
+def _update_score_gather(score, leaf_id, leaf_value, scale):
     vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
     return score + vals[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)].astype(score.dtype)
+
+
+def _score_update_kernel(tbl_ref, lid_ref, score_ref, out_ref, *, L):
+    """score += tbl[lid] as an unrolled compare-select over the L-entry
+    SMEM table — EXACT (the same f32 values are selected, added once)."""
+    lid = lid_ref[:]                                   # (8, c) int32
+    add = jnp.zeros(lid.shape, jnp.float32)
+    for j in range(L):
+        add = jnp.where(lid == j, tbl_ref[0, j], add)
+    out_ref[:] = score_ref[:] + add.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _update_score_pallas(score, leaf_id, vals, interpret=False):
+    """Pallas form of the partition score update.
+
+    The XLA gather of a (L,) table over N rows measured ~8 cycles/row
+    at the 10.5M flagship (86 ms/iter = 11% of training, 13:17 trace);
+    the compare-select sweep runs at VPU rate instead.  Exactness: each
+    row selects the SAME clipped f32 leaf value the gather would read
+    and adds it to the same score element — no reduction-order or
+    precision change anywhere.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    n = score.shape[0]
+    L = int(vals.shape[0])
+    c = 4096
+    npad = (-n) % (8 * c)
+    # same out-of-range semantics as the gather form (clamp to [0, L-1])
+    # so the two engines are bit-equal on EVERY input; pad rows clamp to
+    # 0 but their scores are sliced away below
+    leaf_id = jnp.clip(leaf_id, 0, L - 1)
+    s2 = (jnp.pad(score, (0, npad)) if npad else score).reshape(8, -1)
+    l2 = (jnp.pad(leaf_id, (0, npad)) if npad else leaf_id).reshape(8, -1)
+    m = s2.shape[1]
+    kernel = functools.partial(_score_update_kernel, L=L)
+    out = pl.pallas_call(
+        kernel,
+        grid=(m // c,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),     # (1, L) table
+            pl.BlockSpec((8, c), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, c), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, c), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(s2.shape, score.dtype),
+        interpret=interpret,
+    )(vals[None, :].astype(jnp.float32), l2, s2)
+    return out.reshape(-1)[:n]
+
+
+def update_score_from_partition(score, leaf_id, leaf_value, scale,
+                                engine: str = "gather"):
+    """Train-side score update via the learner's final partition
+    (score_updater.hpp:91-99): score += clip(scale * leaf_value)[leaf_id].
+
+    engine='pallas' (TPU): the compare-select kernel above — bit-equal
+    results, measured faster at large N; anything else: the XLA gather.
+    The kernel's work is O(L) per row (one unrolled select per leaf
+    slot), so large-leaf configs fall back to the gather, whose cost is
+    L-independent — 512 keeps the kernel comfortably ahead of the
+    measured ~8-cycle/row gather while bounding trace/compile size.
+    """
+    if (engine == "pallas" and jax.default_backend() == "tpu"
+            and leaf_value.shape[0] <= 512):
+        vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput,
+                        kMaxTreeOutput)
+        return _update_score_pallas(score, leaf_id, vals)
+    return _update_score_gather(score, leaf_id, leaf_value, scale)
 
 
 @jax.jit

@@ -133,7 +133,7 @@ PARAMETER_SET = {
     "tpu_use_dp", "tpu_histogram_mode", "tpu_profile_dir", "feature_name",
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
-    "tpu_sparse_kernel", "tpu_hist_precision",
+    "tpu_sparse_kernel", "tpu_hist_precision", "tpu_score_update",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -398,6 +398,13 @@ class Config:
         # contractions inside a Pallas kernel (the OrderedSparseBin
         # economics, TPU form).  Forces wave growth; serial learner only.
         "tpu_sparse_kernel": ("bool", False),
+        # 'auto' | 'gather' | 'pallas' — the train-side score update
+        # (score += leaf_value[leaf_id]).  'gather' = XLA small-table
+        # gather; 'pallas' = compare-select kernel (ops/predict.py,
+        # bit-equal, measured target ~10x at 10.5M rows where the XLA
+        # gather ran at ~8 cycles/row).  auto = gather until the pallas
+        # path's on-chip validation lands.
+        "tpu_score_update": ("str", "auto"),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -202,3 +202,45 @@ def test_chunked_pipeline_predict_matches(monkeypatch):
     piped = bst.predict(X)
     assert calls["n"] == 4, calls     # 1500 rows / 400-row chunks
     np.testing.assert_allclose(piped, host, rtol=2e-6, atol=2e-6)
+
+
+def test_score_update_pallas_bit_equal():
+    """tpu_score_update=pallas (compare-select kernel) must be BIT-equal
+    to the XLA gather form — same clipped f32 leaf values selected and
+    added once per row (ops/predict.py)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import (_update_score_gather,
+                                          _update_score_pallas,
+                                          kMaxTreeOutput)
+    rng = np.random.default_rng(11)
+    for n, L in [(5000, 255), (8192, 31), (777, 7)]:
+        score = rng.normal(size=n).astype(np.float32)
+        # include out-of-range sentinels: both engines clamp to [0, L-1]
+        lid = rng.integers(-1, L + 1, size=n).astype(np.int32)
+        lv = rng.normal(size=L).astype(np.float32) * 60  # hits the clamp
+        scale = np.float32(1.7)
+        want = _update_score_gather(jnp.asarray(score), jnp.asarray(lid),
+                                    jnp.asarray(lv), jnp.asarray(scale))
+        vals = jnp.clip(jnp.asarray(lv) * scale,
+                        -kMaxTreeOutput, kMaxTreeOutput)
+        got = _update_score_pallas(jnp.asarray(score), jnp.asarray(lid),
+                                   vals, interpret=True)
+        assert np.array_equal(np.asarray(want), np.asarray(got)), (n, L)
+
+
+def test_score_update_engine_validation():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    import lightgbm_tpu as lgb
+    import pytest
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "binary", "num_boost_round": 1,
+                   "tpu_score_update": "vmem", "verbose": -1},
+                  lgb.Dataset(X, label=y))
+    # explicit gather trains (the auto default path)
+    bst = lgb.train({"objective": "binary", "num_boost_round": 2,
+                     "tpu_score_update": "gather", "verbose": -1},
+                    lgb.Dataset(X, label=y))
+    assert bst.predict(X).shape == (300,)

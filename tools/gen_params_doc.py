@@ -88,6 +88,14 @@ NOTES = {
                           "round-to-nearest term, half the MXU work "
                           "(the reference GPU's single-precision "
                           "histogram trade); auto = hilo",
+    "tpu_sparse_kernel": "true / false — with tpu_sparse, use the "
+                         "entry-chunk MXU sparse store (Pallas kernel, "
+                         "wave growth, serial learner) instead of the "
+                         "segment_sum coordinate store",
+    "tpu_score_update": "auto / gather / pallas — train-side score "
+                        "update engine (score += leaf_value[leaf_id]): "
+                        "XLA gather, or the bit-equal Pallas "
+                        "compare-select kernel; auto = gather",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
@@ -135,8 +143,10 @@ GROUPS = [
         "machine_list_file", "histogram_pool_size"]),
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
-        "tpu_wave_lookup", "tpu_histogram_mode", "tpu_bin_pack", "tpu_sparse",
-        "tpu_use_dp", "tpu_predict", "tpu_profile_dir"]),
+        "tpu_wave_lookup", "tpu_histogram_mode", "tpu_hist_precision",
+        "tpu_score_update", "tpu_bin_pack", "tpu_sparse",
+        "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
+        "tpu_profile_dir"]),
 ]
 
 
