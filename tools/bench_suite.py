@@ -94,6 +94,19 @@ SHAPES = {
         "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32,
         "tpu_hist_precision": "bf16"},
         warmup=3, measured=10, timeout=2700),
+    # compare-select score update at the flagship (the 86 ms/iter = 11%
+    # gather term, 13:17 trace); and the everything-on arm stacking it
+    # with bf16 single-product histograms
+    "higgs_su": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_score_update": "pallas"},
+        warmup=3, measured=10, timeout=2700),
+    "higgs_fast": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_score_update": "pallas", "tpu_hist_precision": "bf16"},
+        warmup=3, measured=10, timeout=2700),
     # pallas_ct at the WIDE shapes (promotion widening: ct auto is
     # currently gated to ncols*bin_pad <= 2048 — these arms supply the
     # wide-F datapoints; the W=16-epsilon / W=32-bosch pathology says

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-4 follow-up to chain_r04d: the score-update kernel arms
+# (higgs_su) and the everything-on stack (higgs_fast = pallas score
+# update + bf16 single-product histograms), measured at the flagship
+# shape after 4d's deck completes.  Budget-gated like the others.
+cd /root/repo || exit 1
+LOG=/tmp/chain_r04.log
+log() { echo "[chain4e] $(date -u +%F\ %T) $*" >> "$LOG"; }
+log "armed (waits for chain_r04d.sh)"
+while pgrep -f "chain_r04d\.sh" > /dev/null; do sleep 120; done
+END=${CHAIN4E_END_EPOCH:-$(( $(date +%s) + 3600 ))}
+left() { echo $(( END - $(date +%s) )); }
+probe_ok() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+from lightgbm_tpu.utils.common import probe_device
+import sys
+sys.exit(0 if probe_device(timeout=120) == "tpu" else 1)
+EOF
+}
+while :; do
+  [ "$(left)" -le 600 ] && { log "no budget; idle-exit"; exit 0; }
+  probe_ok && break
+  sleep 120
+done
+log "tunnel ALIVE"
+l=$(left)
+[ "$l" -le 600 ] && { log "no budget after probe; exit"; exit 0; }
+log "suite3 start (cap $((l-120))s)"
+SUITE_DEADLINE_S=$(( l - 240 )) timeout $(( l - 120 )) \
+  python tools/bench_suite.py higgs_su higgs_fast
+log "suite3 rc=$?"
+log "chain4e complete; chip released"
