@@ -204,11 +204,9 @@ def prepare_cache():
 
 
 def child():
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        # the env var alone does NOT override the axon TPU platform; the
-        # explicit config update before backend init does (conftest trick)
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.common import enable_compilation_cache
 

@@ -118,6 +118,24 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     return d
 
 
+def honor_jax_platforms() -> None:
+    """Apply $JAX_PLATFORMS via the config update, before backend init.
+
+    The env var alone does NOT override the axon TPU platform — the
+    explicit ``jax.config.update("jax_platforms", ...)`` before the
+    first backend touch does (the tests/conftest.py trick).  Every
+    tool that wants to be CPU-pinnable must call this first, or a
+    "CPU-only" invocation silently dispatches to the tunneled TPU.
+    """
+    import os
+
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+
 def probe_device(timeout: float = 90.0) -> str:
     """One tiny matmul in a SUBPROCESS; returns the backend name.
 

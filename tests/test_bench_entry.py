@@ -104,3 +104,22 @@ def test_compilation_cache_default_off_on_cpu():
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120, cwd=REPO, env=env)
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_package_import_is_backend_clean():
+    """honor_jax_platforms() (utils/common.py) is imported THROUGH the
+    package by the CPU-pinnable tools (bench.py child, parity child,
+    tpu_profile) BEFORE the jax_platforms pin applies — which is only
+    safe while `import lightgbm_tpu` touches no JAX backend.  Pin that
+    invariant: a module-level jnp/jax.devices() call sneaking into the
+    import graph would silently dispatch those tools to the tunneled
+    TPU (the failure mode the helper exists to prevent)."""
+    code = (
+        "import lightgbm_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, xla_bridge._backends\n"
+        "print('clean')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "clean" in r.stdout

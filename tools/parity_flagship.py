@@ -108,9 +108,9 @@ def ref_arm():
 
 def child(growth):
     """Our arm on the current backend; prints one JSON line."""
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.common import enable_compilation_cache
     enable_compilation_cache()

@@ -23,13 +23,9 @@ def main():
     n = int(args[0]) if args else 999_424
     outdir = args[1] if len(args) > 1 else "/tmp/tpu_trace"
 
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        # the env var alone does NOT override the axon TPU platform;
-        # the explicit config update before backend init does (the
-        # bench.py / tests/conftest.py trick) — without this a
-        # "CPU-only" invocation would silently hit the real chip
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from tools.bench_modes import make_data
     import lightgbm_tpu as lgb
 
