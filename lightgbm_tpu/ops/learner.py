@@ -242,9 +242,15 @@ class SerialTreeLearner:
                 # per wave).  Wide-F shapes keep pallas_t until ct has
                 # on-chip datapoints there (epsilon/msltr ct arms are
                 # queued; the forced-W=16 epsilon pathology shows wide-F
-                # cells can surprise, BENCH_NOTES.md).
+                # cells can surprise, BENCH_NOTES.md).  Both ct
+                # measurements are single-chip serial arms, so the
+                # promotion is scoped to serial EXECUTION — psum_axis is
+                # None, which includes data configs falling back to the
+                # serial engine on one device (ADVICE r4); the true DP
+                # learner keeps pallas_t until a DP A/B lands.
                 hist_mode = ("pallas_ct"
                              if ncols * _bin_pad(nbins) <= 2048
+                             and psum_axis is None
                              else "pallas_t")
             else:
                 hist_mode = "onehot" if on_tpu else "scatter"

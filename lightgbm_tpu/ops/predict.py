@@ -198,9 +198,13 @@ def update_score_from_partition(score, leaf_id, leaf_value, scale,
     slot), so large-leaf configs fall back to the gather, whose cost is
     L-independent — 512 keeps the kernel comfortably ahead of the
     measured ~8-cycle/row gather while bounding trace/compile size.
+    f32-only: with tpu_use_dp=true the score/leaf values are f64 and the
+    kernel's f32 table cast would break the bit-equality claim (and f64
+    VMEM blocks don't lower on TPU) — those configs use the gather.
     """
     if (engine == "pallas" and jax.default_backend() == "tpu"
-            and leaf_value.shape[0] <= 512):
+            and leaf_value.shape[0] <= 512
+            and score.dtype == jnp.float32):
         vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput,
                         kMaxTreeOutput)
         return _update_score_pallas(score, leaf_id, vals)
