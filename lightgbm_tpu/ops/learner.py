@@ -141,6 +141,41 @@ def resolve_wave_width(config: Config, num_leaves: int,
     return 32
 
 
+# the VMEM budget the Pallas wave kernels compile under, shared with the
+# auto hist-mode gate (64 MB of the kernels' 100 MB compiler limit so
+# input tiles and temporaries fit too)
+_WAVE_VMEM_GATE = 64 << 20
+
+# Mid-size accumulator-block pathology, measured on v5e (BENCH_NOTES.md,
+# r4): hist blocks of ~17-25 MB run 10-43x slower than the same shape
+# one width tier up (~34-49 MB) — epsilon forced-W16 19.1 s/iter vs W32
+# 0.45; bosch dense W32 9.75 vs W64 0.90; yahoo's 2.1x headline sits at
+# a 17 MB W32 cell.  Root cause unconfirmed (suspect: Mosaic scheduling
+# of mid-size out blocks, ops/pallas_wave.py::_tile_plan); until a trace
+# lands, auto widths BUMP OUT of the band when the doubled block still
+# compiles.  Bounds are deliberately wide of the measured cells.
+_HIST_BLOCK_BAND = (12 << 20, 30 << 20)
+
+
+def band_adjusted_width(width: int, ncols: int, bin_pad: int) -> int:
+    """Auto-width escape from the pathological hist-block band: double W
+    (up to 64) while the (ncols*bin_pad, 3W) f32 accumulator block lands
+    inside the measured slow band and the doubled block stays within the
+    kernels' VMEM gate.  Explicit user widths never pass through here,
+    and neither does the order-sensitivity W=1 pin (resolve_wave_width's
+    quality gate for DART/GOSS/lambdarank under batched order) — a
+    speed escape must not undo a quality decision."""
+    if width <= 1:
+        return width
+    lo, hi = _HIST_BLOCK_BAND
+    block = ncols * bin_pad * 12 * width
+    while (lo <= block < hi and width < 64
+           and block * 2 <= _WAVE_VMEM_GATE):
+        width *= 2
+        block *= 2
+    return width
+
+
 def build_split_params(config: Config) -> SplitParams:
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
@@ -354,6 +389,21 @@ class SerialTreeLearner:
         self.wave_width = (resolve_wave_width(config, self.num_leaves,
                                               self.wave_order)
                            if growth == "wave" else 1)
+        if growth == "wave" and int(config.tpu_wave_width) == -1:
+            from .wave import pallas_wave_active as _pwa
+            if _pwa(self.hist_mode, self.dtype):
+                # escape the measured mid-size accumulator-block
+                # pathology (band_adjusted_width) — auto widths only
+                self.wave_width = band_adjusted_width(
+                    self.wave_width, ncols, _bin_pad(nbins))
+        if bool(config.tpu_wave_compact) and not (
+                growth == "wave" and self.hist_mode == "pallas_ct"):
+            # explicit opt-ins must not be dropped silently (same
+            # policy as tpu_sparse / tpu_bin_pack)
+            Log.warning("tpu_wave_compact=true ignored: requires wave "
+                        "growth with the fused pallas_ct kernel "
+                        "(resolved growth=%s, histogram mode=%s)",
+                        growth, self.hist_mode)
         hp = str(config.tpu_hist_precision).strip().lower()
         if hp not in ("auto", "hilo", "bf16"):
             Log.fatal("Unknown tpu_hist_precision %s (expected auto/"
@@ -532,7 +582,8 @@ class SerialTreeLearner:
                 self.cache_hists, hist_mode,
                 int(config.tpu_wave_chunk), self.packed_cols,
                 self.sparse_col_cap, self.wave_order == "exact",
-                self.wave_lookup, self.hist_hilo)
+                self.wave_lookup, self.hist_hilo,
+                bool(config.tpu_wave_compact))
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;

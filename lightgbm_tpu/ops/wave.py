@@ -108,7 +108,9 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                       hist_mode: str = "onehot", chunk: int = 16384,
                       packed_cols: int = 0, sparse_col_cap: int = 0,
                       with_xt: bool = False, exact_order: bool = False,
-                      lookup: str = "onehot", hist_hilo: bool = True):
+                      lookup: str = "onehot", hist_hilo: bool = True,
+                      compact: bool = False,
+                      pallas_interpret: bool = False):
     """Bind meta/bundle onto the cached wave-grow program (same contract as
     ops/grow.make_grow_fn: grow(X, grad, hess, row_mult, feature_mask) ->
     (TreeArrays, leaf_id)).
@@ -122,7 +124,8 @@ def make_wave_grow_fn(num_leaves: int, num_bins: int, meta: FeatureMeta,
                           wave_width, hist_dtype, psum_axis,
                           bundle is not None, group_bins, cache_hists,
                           hist_mode, chunk, packed_cols, sparse_col_cap,
-                          exact_order, lookup, hist_hilo)
+                          exact_order, lookup, hist_hilo, compact,
+                          pallas_interpret)
 
     if with_xt:
         def grow(X, grad, hess, row_mult, feature_mask, Xt):
@@ -151,7 +154,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                    cache_hists: bool, hist_mode: str, chunk: int,
                    packed_cols: int = 0, sparse_col_cap: int = 0,
                    exact_order: bool = False, lookup: str = "onehot",
-                   hist_hilo: bool = True):
+                   hist_hilo: bool = True, compact: bool = False,
+                   pallas_interpret: bool = False):
     """packed_cols > 0: X is 4-bit packed (ops/pack.py, two columns per
     byte) and packed_cols is the LOGICAL column count; every chunk is
     unpacked in-scan so the full-width matrix never hits HBM (the
@@ -186,13 +190,24 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # Opt-in (hist_mode='pallas' row-major / 'pallas_t' transposed) while
     # their end-to-end win is validated; precision is handled by the bf16
     # hi/lo weight split (manual rounding — Mosaic's cast truncates).
-    use_pallas_hist = pallas_wave_active(hist_mode, hist_dtype)
+    # pallas_interpret=True (tests only) runs the Pallas kernels in
+    # interpret mode on any backend, so the ct engine path — including
+    # spectator-row compaction — is CPU-testable end-to-end
+    use_pallas_hist = pallas_wave_active(hist_mode, hist_dtype) or (
+        pallas_interpret and hist_dtype == jnp.float32
+        and hist_mode in ("pallas",) + WAVE_ONLY_MODES)
     # 'pallas_ct' (v5) is fused (partition + histogram in one kernel,
     # ONE read of Xt per wave) and transposed; the earlier fused
     # variants pallas_f/pallas_ft were deleted after losing every
     # on-chip A/B to pallas_t (tools/AB_RESULTS.md, BENCH_NOTES.md r4)
     pallas_transposed = hist_mode in ("pallas_t", "pallas_ct")
     pallas_fused = hist_mode == "pallas_ct"
+    # spectator-row compaction rides the fused kernel only, and only
+    # under serial execution (per-shard divergent tier choices inside
+    # shard_map would be legal — no collectives in the branches — but
+    # have no measurement yet)
+    compact = bool(compact and pallas_fused and use_pallas_hist
+                   and psum_axis is None)
 
     def maybe_psum(x):
         if psum_axis is not None:
@@ -314,11 +329,13 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 from .pallas_wave import wave_histogram_pallas_t
                 return wave_histogram_pallas_t(Xt, lid, w3, cid, hist_bins,
                                                logical_cols=packed_cols,
-                                               hilo=hist_hilo)
+                                               hilo=hist_hilo,
+                                               interpret=pallas_interpret)
             from .pallas_wave import wave_histogram_pallas
             return wave_histogram_pallas(X, lid, w3, cid, hist_bins,
                                          logical_cols=packed_cols,
-                                         hilo=hist_hilo)
+                                         hilo=hist_hilo,
+                                         interpret=pallas_interpret)
 
         def wave_pass(leaf_id, tbl, cols, psrc, small_id, valid):
             """Partition + child histograms, fused into ONE chunked sweep.
@@ -351,7 +368,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                     Xt, leaf_id, w3,
                     jnp.where(valid, small_id, -1), cols, psrc,
                     hist_bins, bundled=has_bundle,
-                    logical_cols=packed_cols, hilo=hist_hilo)
+                    logical_cols=packed_cols, hilo=hist_hilo,
+                    interpret=pallas_interpret)
             lb = jnp.pad(leaf_id, (0, pad)).reshape(nch, c) if pad \
                 else leaf_id.reshape(nch, c)
             wpad = jnp.pad(w3, ((0, pad), (0, 0))) if pad else w3
@@ -414,6 +432,68 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 hist = flat.reshape(Fc, hist_bins, W, 3).transpose(2, 0, 1,
                                                                    3)
             return new_leaf_id, hist
+
+        # ---- spectator-row compaction (tpu_wave_compact): capacity
+        # tiers at 1/2, 1/4, 1/8 of N, 512-aligned, ascending.  Late
+        # waves split leaves holding a shrinking fraction of rows
+        # (measured frontier occupancy at 300k x 28/255 leaves: waves 7+
+        # touch 17-49% of rows — ~35% of ALL kernel row work is rows
+        # whose leaf is final, ROADMAP r4), the same economics as the
+        # reference's leaf-ordered bin iteration
+        # (ordered_sparse_bin.hpp:26-209): touch only the rows of the
+        # leaves being split.
+        compact_caps = []
+        if compact and not sparse_mode:
+            for frac in (2, 4, 8):
+                cap = -(-min(n, max(1024, -(-n // frac))) // 512) * 512
+                if cap < n and cap not in compact_caps:
+                    compact_caps.append(cap)
+            compact_caps.sort()
+
+        def compact_wave_pass(leaf_id, tbl, cols, psrc, small_id, valid):
+            """Fused wave pass over the ACTIVE rows only (leaf in the
+            wave's parent set), gathered into the smallest tier that
+            holds them; full-N fallback when none does.  Exact: a
+            spectator row matches no parent (routes nowhere) and no
+            child (zero histogram weight), and its 0.0 contribution
+            passes through every f32 partial sum unchanged — trees are
+            pinned equal to the full-N pass in tests/test_wave_compact.py.
+            Cost per wave: one (L,)-table membership gather, a
+            stable-compact index build (cumsum), and the row gathers —
+            against kernel row work shrinking from N to the tier."""
+            from .pallas_wave import wave_partition_hist_pallas_ct
+            act_tbl = jnp.zeros(L, bool).at[
+                jnp.where(valid, psrc, L)].set(True, mode="drop")
+            mask = jnp.take(act_tbl, leaf_id)            # (N,)
+            active_n = jnp.sum(mask.astype(jnp.int32))   # TRUE row count
+            cid = jnp.where(valid, small_id, -1)
+
+            def tier(cap):
+                def run():
+                    idx = jnp.nonzero(mask, size=cap, fill_value=n)[0]
+                    # fill semantics mirror the kernel's own padding:
+                    # leaf -2 matches nothing, weight 0 adds nothing
+                    xt_c = jnp.take(Xt, idx, axis=1, mode="fill",
+                                    fill_value=0)
+                    lid_c = jnp.take(leaf_id, idx, mode="fill",
+                                     fill_value=-2)
+                    w3_c = jnp.take(w3, idx, axis=0, mode="fill",
+                                    fill_value=0.0)
+                    new_c, hist = wave_partition_hist_pallas_ct(
+                        xt_c, lid_c, w3_c, cid, cols, psrc, hist_bins,
+                        bundled=has_bundle, logical_cols=packed_cols,
+                        hilo=hist_hilo, interpret=pallas_interpret)
+                    return (leaf_id.at[idx].set(new_c, mode="drop"),
+                            hist)
+                return run
+
+            def ladder(caps):
+                if not caps:
+                    return wave_pass(leaf_id, tbl, cols, psrc, small_id,
+                                     valid)
+                return lax.cond(active_n <= caps[0], tier(caps[0]),
+                                lambda: ladder(caps[1:]))
+            return ladder(compact_caps)
 
         def rehist(leaf_id, ids, valid):
             """Histograms of `ids` children only (no partition) — the
@@ -570,6 +650,9 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             if sparse_mode:
                 leaf_id, hist_small = sparse_wave_pass(
                     leaf_id, tbl, small_id, valid, col_w)
+            elif compact_caps:
+                leaf_id, hist_small = compact_wave_pass(
+                    leaf_id, tbl, cols, psrc, small_id, valid)
             else:
                 leaf_id, hist_small = wave_pass(leaf_id, tbl, cols, psrc,
                                                 small_id, valid)

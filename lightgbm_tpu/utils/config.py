@@ -134,6 +134,7 @@ PARAMETER_SET = {
     "tpu_growth", "tpu_wave_width", "tpu_bin_pack", "tpu_wave_chunk",
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
     "tpu_sparse_kernel", "tpu_hist_precision", "tpu_score_update",
+    "tpu_wave_compact",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -405,6 +406,17 @@ class Config:
         # gather ran at ~8 cycles/row).  auto = gather until the pallas
         # path's on-chip validation lands.
         "tpu_score_update": ("str", "auto"),
+        # spectator-row compaction for the fused wave kernel
+        # (tpu_histogram_mode=pallas_ct): late waves touch only the rows
+        # whose leaf is still splitting (~35% of row work at the flagship
+        # recipe is rows whose leaf is final — measured frontier
+        # occupancy, ROADMAP.md r4), so the wave gathers the active rows
+        # into a capacity tier (1/2, 1/4, 1/8 of N) and runs the kernel
+        # on the compacted slab.  Exact: spectator rows route nowhere and
+        # carry zero histogram weight, so dropping them changes no sums
+        # (x + 0.0 == x in f32); pinned bit-equal vs the full-N pass in
+        # tests/test_wave_compact.py.  Off until the on-chip A/B lands.
+        "tpu_wave_compact": ("bool", False),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

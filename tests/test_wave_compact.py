@@ -1,0 +1,140 @@
+"""Spectator-row compaction (tpu_wave_compact) vs the full-N fused pass.
+
+Late waves split leaves holding a shrinking fraction of rows; the
+compaction tiers (ops/wave.py compact_wave_pass) gather only the active
+rows before the fused pallas_ct kernel runs.  The claim under test: the
+compacted engine produces THE SAME TREES and THE SAME ROW PARTITION as
+the full-N engine — a spectator row matches no parent and no child, so
+dropping it changes no routing decision (exact integer/f32 compares) and
+no histogram sum (its contribution is exactly 0.0).
+
+Runs the real engine end-to-end on CPU via interpret-mode kernels
+(make_wave_core's pallas_interpret static).  Shapes are chosen so the
+1024/2048-row tiers genuinely engage (62 splits over 6000 rows leave
+late-wave frontiers far below the smallest tier).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.ops.learner import build_split_params
+from lightgbm_tpu.ops.split_finder import FeatureMeta
+from lightgbm_tpu.ops.wave import make_wave_grow_fn
+from lightgbm_tpu.utils.config import Config
+
+N, F = 6000, 8
+
+
+def _setup(num_leaves):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(N, F))
+    y = (X[:, 1] + np.cos(X[:, 4] * 2) + 0.4 * rng.normal(size=N) > 0.5)
+    cfg = Config({"num_leaves": num_leaves, "min_data_in_leaf": 3,
+                  "max_bin": 63, "verbose": -1})
+    td = TrainingData.from_matrix(X, label=y.astype(np.float64),
+                                  config=cfg)
+    meta = FeatureMeta(num_bin=jnp.asarray(td.num_bin_arr),
+                       default_bin=jnp.asarray(td.default_bin_arr),
+                       is_categorical=jnp.asarray(td.is_categorical_arr))
+    grad = jnp.asarray((0.5 - y).astype(np.float32))
+    hess = jnp.full(N, 0.25, jnp.float32)
+    return cfg, td, meta, grad, hess
+
+
+def _run(compact, num_leaves, wave_width, row_mult=None,
+         exact_order=False):
+    cfg, td, meta, grad, hess = _setup(num_leaves)
+    params = build_split_params(cfg)
+    nb = int(td.num_bin_arr.max())
+    X = jnp.asarray(td.binned)
+    grow = make_wave_grow_fn(num_leaves, nb, meta, params, -1,
+                             wave_width=wave_width,
+                             hist_mode="pallas_ct", with_xt=True,
+                             exact_order=exact_order,
+                             compact=compact, pallas_interpret=True)
+    rm = (jnp.ones(N, jnp.float32) if row_mult is None
+          else jnp.asarray(row_mult))
+    fm = jnp.ones(td.num_features, dtype=bool)
+    tree, leaf_id = jax.jit(grow)(X, grad, hess, rm, fm,
+                                  jnp.transpose(X))
+    return tree, leaf_id
+
+
+def _trees_identical(a, b):
+    for field in ("num_leaves", "split_feature", "threshold_bin",
+                  "default_bin_for_zero", "default_bin", "is_cat",
+                  "left_child", "right_child", "leaf_parent",
+                  "leaf_count", "leaf_depth", "internal_count"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, field)),
+                                      np.asarray(getattr(b, field)),
+                                      err_msg=field)
+    # float fields: bit-equality is the design claim (0.0 contributions
+    # pass through f32 partial sums unchanged)
+    for field in ("split_gain", "internal_value", "leaf_value"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, field)),
+                                      np.asarray(getattr(b, field)),
+                                      err_msg=field)
+
+
+@pytest.mark.parametrize("wave_width", [1, 4])
+def test_compact_matches_full_pass(wave_width):
+    """62 splits over 6000 rows: late waves are far under the 1024-row
+    tier, so the ladder's gathered branches run for real."""
+    t_full, l_full = _run(False, 63, wave_width)
+    t_comp, l_comp = _run(True, 63, wave_width)
+    assert int(t_full.num_leaves) == 63
+    _trees_identical(t_full, t_comp)
+    np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
+
+
+def test_compact_matches_full_pass_exact_order():
+    """The exact-order commit/rollback path remaps leaf ids AFTER the
+    wave pass — the compacted scatter-back must compose with it."""
+    t_full, l_full = _run(False, 63, 4, exact_order=True)
+    t_comp, l_comp = _run(True, 63, 4, exact_order=True)
+    _trees_identical(t_full, t_comp)
+    np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
+
+
+def test_compact_matches_full_pass_with_bagging():
+    """Zero-weight (out-of-bag) rows still carry leaf ids the score
+    update needs: the tier choice must count ROWS, not summed weights —
+    a tier sized by weighted counts would truncate the gather and leave
+    OOB rows unrouted."""
+    rng = np.random.default_rng(7)
+    rm = (rng.random(N) < 0.5).astype(np.float32)   # ~50% weight-0 rows
+    t_full, l_full = _run(False, 63, 4, row_mult=rm)
+    t_comp, l_comp = _run(True, 63, 4, row_mult=rm)
+    _trees_identical(t_full, t_comp)
+    np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
+
+
+def test_compact_config_reaches_serial_learner():
+    """tpu_wave_compact threads from Config through the serial learner's
+    wave-core statics (no-op off TPU, but the static must arrive)."""
+    from lightgbm_tpu.ops import learner as learner_mod
+    seen = {}
+    from lightgbm_tpu.ops.wave import make_wave_jit as real_jit
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 3, "max_bin": 63,
+                  "verbose": -1, "tpu_growth": "wave",
+                  "tpu_wave_compact": True})
+    td = TrainingData.from_matrix(X, label=y, config=cfg)
+    import lightgbm_tpu.ops.wave as wave_mod
+
+    def spy(*args):
+        seen["args"] = args
+        return real_jit(*args)
+
+    old = wave_mod.make_wave_jit
+    wave_mod.make_wave_jit = spy
+    try:
+        learner_mod.SerialTreeLearner(cfg, td)
+    finally:
+        wave_mod.make_wave_jit = old
+    assert seen["args"][-1] is True       # the compact static arrived

@@ -83,6 +83,29 @@ SHAPES = {
         "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
         "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
         warmup=3, measured=10, timeout=2700),
+    # spectator-row compaction at the flagship (tpu_wave_compact): late
+    # waves gather only active rows (~35% of kernel row work is
+    # spectator rows, ROADMAP r4) — trees pinned bit-equal to the
+    # full-N pass (tests/test_wave_compact.py), so the decision is
+    # speed-only: promote to auto iff AUC == higgs_ct arm EXACTLY and
+    # it/s >= 1.1x the ct number
+    "higgs_compact": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32,
+        "tpu_wave_compact": True},
+        warmup=3, measured=10, timeout=2700),
+    # exact-commit-order waves at the flagship (tpu_wave_order=exact):
+    # trees match tpu_wave_width=1 bit-for-bit, so its AUC delta vs the
+    # reference equals the EXACT arm's (+7.7e-6 at 10.5M).  This is the
+    # fallback headline config if the 10.5M batched-wave parity arm
+    # lands >1e-4 (VERDICT r4 #5) — this arm prices that fallback.
+    "higgs_xo": dict(n=10_500_000, f=28, cache_as="higgs", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32,
+        "tpu_wave_order": "exact"},
+        warmup=3, measured=10, timeout=2700),
     # single-bf16-product histograms (tpu_hist_precision=bf16, the
     # gpu_use_dp=false analog): the kernel is MXU-FLOP-bound (~71%
     # utilization at the flagship, 13:17 trace), so halving the dots

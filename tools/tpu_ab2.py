@@ -222,11 +222,47 @@ R04P = [
 ]
 
 
+R05B = [
+    # Bosch-dense attack stack (target: beat the reference CPU's ~0.40
+    # s/iter at 1M x 968 @1%, VERDICT r4 #6).  Baseline: dense wave64
+    # pallas_t 0.901 s/iter (r4).  Three multiplicative levers, armed
+    # in isolation then stacked:
+    #  - pallas_ct at W=64 (47.6 MB block, inside the 64 MB gate): one
+    #    Xt read/wave instead of partition scan + kernel;
+    #  - tpu_wave_compact: Bosch's 255-leaf frontier on 1M rows leaves
+    #    late waves far under the 1/8 tier — expected >=1.3x;
+    #  - bf16 single-product: ~1.7-1.9x on the FLOP-bound kernel.
+    # 0.90 / (ct gain) / 1.4 / 1.8 lands ~0.3 if each lever holds.
+    ("bosch1Mx968 ct W=64",
+     {"kind": "sparse", "n": 1_000_000, "mode": "pallas_ct", "width": 64,
+      "timeout": 2700, "extra": {"tpu_growth": "wave"}}),
+    ("bosch1Mx968 ct W=64 compact",
+     {"kind": "sparse", "n": 1_000_000, "mode": "pallas_ct", "width": 64,
+      "timeout": 2700,
+      "extra": {"tpu_growth": "wave", "tpu_wave_compact": True}}),
+    ("bosch1Mx968 ct W=64 compact bf16",
+     {"kind": "sparse", "n": 1_000_000, "mode": "pallas_ct", "width": 64,
+      "timeout": 2700,
+      "extra": {"tpu_growth": "wave", "tpu_wave_compact": True,
+                "tpu_hist_precision": "bf16"}}),
+    # flagship compaction A/B at 1M (the cheap proxy the suite's
+    # higgs_compact arm confirms at 10.5M)
+    ("pallas_ct W=32 compact",
+     {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32,
+      "extra": {"tpu_wave_compact": True}}),
+]
+
+
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if args else 999_424
     if "--r04p" in sys.argv:
         combos = [(name, dict(spec, n=n)) for name, spec in R04P]
+        run_combos(combos, n)
+        return
+    if "--r05b" in sys.argv:
+        combos = [(name, dict(spec, n=spec["n"] or n))
+                  for name, spec in R05B]
         run_combos(combos, n)
         return
     if "--followup" in sys.argv:
