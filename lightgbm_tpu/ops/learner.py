@@ -396,14 +396,19 @@ class SerialTreeLearner:
                 # pathology (band_adjusted_width) — auto widths only
                 self.wave_width = band_adjusted_width(
                     self.wave_width, ncols, _bin_pad(nbins))
-        if bool(config.tpu_wave_compact) and not (
-                growth == "wave" and self.hist_mode == "pallas_ct"):
-            # explicit opt-ins must not be dropped silently (same
-            # policy as tpu_sparse / tpu_bin_pack)
-            Log.warning("tpu_wave_compact=true ignored: requires wave "
-                        "growth with the fused pallas_ct kernel "
-                        "(resolved growth=%s, histogram mode=%s)",
-                        growth, self.hist_mode)
+        if bool(config.tpu_wave_compact):
+            from .wave import pallas_wave_active as _pwa2
+            if not (growth == "wave" and self.hist_mode == "pallas_ct"
+                    and _pwa2(self.hist_mode, self.dtype)):
+                # explicit opt-ins must not be dropped silently (same
+                # policy as tpu_sparse / tpu_bin_pack); the kernel gate
+                # (_pwa2) also covers non-TPU backends and f64
+                Log.warning("tpu_wave_compact=true ignored: requires "
+                            "wave growth with the fused pallas_ct "
+                            "kernel on TPU with f32 accumulation "
+                            "(resolved growth=%s, histogram mode=%s, "
+                            "backend=%s)", growth, self.hist_mode,
+                            jax.default_backend())
         hp = str(config.tpu_hist_precision).strip().lower()
         if hp not in ("auto", "hilo", "bf16"):
             Log.fatal("Unknown tpu_hist_precision %s (expected auto/"

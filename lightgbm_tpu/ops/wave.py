@@ -262,6 +262,16 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         # ---- sparse (coordinate-store) variants: partition reads ONLY
         # the W chosen split columns; all W child histograms are ONE
         # segment_sum over the nonzeros
+        # the three O(nnz) weight-channel gathers are tree-constant —
+        # hoisted to ONE gather per tree (gather_entry_weights); only
+        # the leaf-id gather stays per-wave
+        if mxu_sparse and (jax.default_backend() == "tpu"
+                           and hist_dtype == jnp.float32):
+            from .sparse_mxu import gather_entry_weights
+            mxu_entry_w = gather_entry_weights(X, w3)
+        else:
+            mxu_entry_w = None
+
         def sparse_child_hists(lid, ids, valid):
             if mxu_sparse:
                 from .sparse_mxu import (chunked_child_hists_ref,
@@ -270,7 +280,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                 if (jax.default_backend() == "tpu"
                         and hist_dtype == jnp.float32):
                     return sparse_wave_histogram_mxu(
-                        X, lid, w3, cid, hist_bins, Fc, hilo=hist_hilo)
+                        X, lid, w3, cid, hist_bins, Fc, hilo=hist_hilo,
+                        entry_weights=mxu_entry_w)
                 return chunked_child_hists_ref(
                     X, lid, w3, cid, hist_bins, Fc, L)
             slot_tbl = jnp.full(L, -1, jnp.int32).at[
@@ -453,11 +464,19 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
         def compact_wave_pass(leaf_id, tbl, cols, psrc, small_id, valid):
             """Fused wave pass over the ACTIVE rows only (leaf in the
             wave's parent set), gathered into the smallest tier that
-            holds them; full-N fallback when none does.  Exact: a
-            spectator row matches no parent (routes nowhere) and no
-            child (zero histogram weight), and its 0.0 contribution
-            passes through every f32 partial sum unchanged — trees are
-            pinned equal to the full-N pass in tests/test_wave_compact.py.
+            holds them; full-N fallback when none does.
+
+            Exactness: a spectator row matches no parent (routes
+            nowhere) and no child (zero histogram weight), so routing
+            and SPLIT STRUCTURE are identical to the full-N pass.
+            Histogram sums are identical under strictly sequential f32
+            accumulation (adding 0.0 anywhere is the identity) — but
+            compaction shifts active rows across kernel row-tile
+            boundaries, so reductions that pair per-tile partial sums
+            non-sequentially reassociate and float fields (gains, leaf
+            values) can drift by f32 ulps.  Pinned in
+            tests/test_wave_compact.py: bit-equal trees at single-tile
+            N, equal structure + ~1e-5-close floats at multi-tile N.
             Cost per wave: one (L,)-table membership gather, a
             stable-compact index build (cumsum), and the row gathers —
             against kernel row work shrinking from N to the tier."""

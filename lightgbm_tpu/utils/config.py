@@ -412,9 +412,10 @@ class Config:
         # recipe is rows whose leaf is final — measured frontier
         # occupancy, ROADMAP.md r4), so the wave gathers the active rows
         # into a capacity tier (1/2, 1/4, 1/8 of N) and runs the kernel
-        # on the compacted slab.  Exact: spectator rows route nowhere and
-        # carry zero histogram weight, so dropping them changes no sums
-        # (x + 0.0 == x in f32); pinned bit-equal vs the full-N pass in
+        # on the compacted slab.  Split structure is exact (spectator
+        # rows route nowhere and carry zero histogram weight); float
+        # fields can drift by f32 ulps at multi-tile N (tile-boundary
+        # reassociation) — pinned vs the full-N pass in
         # tests/test_wave_compact.py.  Off until the on-chip A/B lands.
         "tpu_wave_compact": ("bool", False),
     }

@@ -94,6 +94,26 @@ def test_kernel_interpret_matches_dense():
                                atol=5e-4)
 
 
+def test_kernel_pregathered_weights_identical():
+    """entry_weights (the per-tree hoisted gathers, r5) must be exactly
+    the in-call gather — same kernel inputs, bit-identical output."""
+    from lightgbm_tpu.ops.sparse_mxu import gather_entry_weights
+    b, L = 14, 12
+    X, fill, leaf_id, w3 = _sparse_data(b=b, L=L)
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128,
+                                        chunk_block=4)
+    cid = np.array([0, 2, 4, -1, 7], np.int32)
+    base = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                     jnp.asarray(w3), jnp.asarray(cid),
+                                     b, X.shape[1], interpret=True)
+    ew = gather_entry_weights(store, jnp.asarray(w3))
+    got = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                    jnp.asarray(w3), jnp.asarray(cid),
+                                    b, X.shape[1], interpret=True,
+                                    entry_weights=ew)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+
 def test_kernel_nondefault_chunk_block():
     """A store padded to a chunk_block that is NOT a multiple of the
     kernel's CHUNK_BLOCK still runs (the grid step divides nc exactly)."""
