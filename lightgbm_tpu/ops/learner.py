@@ -544,8 +544,14 @@ class SerialTreeLearner:
                     fill = column_fill_bins(train_data.num_bin_arr,
                                             train_data.default_bin_arr,
                                             train_data.bundle)
-                build = (build_chunked_store if sparse_kernel
-                         else build_sparse_store)
+                if sparse_kernel:
+                    # auto_uniform: low-skew stores widen the entry
+                    # chunk so each column is ONE MXU dot (sparse_mxu)
+                    def build(b, fl, nb):
+                        return build_chunked_store(b, fl, nb,
+                                                   auto_uniform=True)
+                else:
+                    build = build_sparse_store
                 self.X, self.sparse_col_cap, self.sparse_device_bytes = \
                     build(binned, fill, nbins_dev)
         elif (device_data is not None

@@ -66,13 +66,24 @@ class ChunkedSparseStore(NamedTuple):
 
 def build_chunked_store(binned: np.ndarray, fill: np.ndarray,
                         num_bins: int, entry_chunk: int = ENTRY_CHUNK,
-                        chunk_block: int = CHUNK_BLOCK):
+                        chunk_block: int = CHUNK_BLOCK,
+                        auto_uniform: bool = False):
     """Host-side build from the (N, F) binned matrix.
 
     ``fill`` is the per-column bin the downstream view reconstructs (or
     never reads) — see sparse_store.column_fill_bins.  Returns
     (store, cap_chunks, device_bytes); cap_chunks bounds any single
     column's chunk count (the partition window size).
+
+    auto_uniform (r5): when per-column skew is low, the entry chunk is
+    widened so EVERY column is exactly one chunk (E = max column nnz
+    rounded up to the base chunk).  Same structure, but the kernel then
+    runs one (Bp, E) x (E, 3K) dot per COLUMN instead of ~cap tiny
+    K=512 dots per column — at the Bosch shape that is ~19k
+    M=64/N=96/K=512 dots collapsing into 968 K~10k dots (near-full MXU
+    utilization, ~20x fewer dispatch+accumulate rounds).  Taken only
+    when the pad overhead stays under 50% (skewed columns would blow
+    the uniform layout up; they keep the narrow chunks).
     """
     n, f = binned.shape
     e = int(entry_chunk)
@@ -81,6 +92,20 @@ def build_chunked_store(binned: np.ndarray, fill: np.ndarray,
     bins = binned.T[mask_t].astype(np.int64)
     counts = np.bincount(cols, minlength=f).astype(np.int64)
     cchunks = -(-counts // e)                       # chunks per column
+    if auto_uniform and f and len(rows):
+        e_uni = max(e, -(-int(counts.max()) // e) * e)
+        # all-fill columns cost zero chunks in EITHER layout — charge
+        # the uniform layout only for its nonzero columns; and bound E
+        # absolutely so a dense-ish low-skew store cannot widen past
+        # what the kernel's VMEM blocks hold (5 x (8, E) i32/f32 input
+        # blocks + the (3K, E) hi/lo weights + the (Bp, E) one-hot is
+        # ~2 KB per entry at K=64 — 16384 keeps a grid step well under
+        # the 100 MB budget)
+        nzc = int(np.count_nonzero(counts))
+        if (e_uni <= 16384
+                and nzc * e_uni <= 1.5 * max(int(cchunks.sum()), 1) * e):
+            e = e_uni
+            cchunks = -(-counts // e)               # now <= 1 per column
     col_cptr = np.zeros(f + 1, np.int64)
     np.cumsum(cchunks, out=col_cptr[1:])
     nc = int(col_cptr[-1])

@@ -94,6 +94,56 @@ def test_kernel_interpret_matches_dense():
                                atol=5e-4)
 
 
+def test_auto_uniform_layout_matches_dense():
+    """auto_uniform widens the entry chunk so low-skew columns become
+    ONE chunk each (one MXU dot per column, r5); same store contract,
+    same histograms, and the skewed case must refuse the layout."""
+    b, L = 14, 12
+    # low-skew data (no dense column): uniform layout engages
+    X, fill, leaf_id, w3 = _sparse_data(b=b, L=L, dense_col=None)
+    store, cap, _ = build_chunked_store(X, fill, b, entry_chunk=128,
+                                        auto_uniform=True)
+    assert cap == 1                      # every column fits one chunk
+    cid = np.array([0, 2, 4, -1, 7], np.int32)
+    got = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                    jnp.asarray(w3), jnp.asarray(cid),
+                                    b, X.shape[1], interpret=True)
+    want = _dense_oracle(X, fill, leaf_id, w3, cid, b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+    # the split-column window still reconstructs every column exactly
+    for j in (0, 5, X.shape[1] - 1):
+        col = chunked_split_column(store, j, X.shape[0], cap)
+        np.testing.assert_array_equal(np.asarray(col),
+                                      X[:, j].astype(np.int64))
+    # skew gate: one dense column would blow the uniform layout up ->
+    # the narrow-chunk layout must be kept
+    Xs, fills, _, _ = _sparse_data(b=b, L=L, dense_col=3)
+    s2, cap2, _ = build_chunked_store(Xs, fills, b, entry_chunk=128,
+                                      auto_uniform=True)
+    assert cap2 > 1
+    assert s2.ent_bin.shape[1] == 128    # base chunk width kept
+    # all-fill columns cost zero chunks in either layout and must not
+    # be charged against the uniform gate: 1 busy + many constant
+    # columns still widens
+    Xc = np.tile(fills, (2000, 1)).astype(np.uint8)
+    rng = np.random.default_rng(5)
+    busy = rng.integers(0, b, size=2000).astype(np.uint8)
+    Xc[:, 1] = busy
+    s3, cap3, _ = build_chunked_store(Xc, fills, b, entry_chunk=128,
+                                      auto_uniform=True)
+    assert cap3 == 1
+    assert s3.ent_bin.shape[1] >= 1920   # widened to the busy column
+    # absolute VMEM ceiling: a low-skew store whose columns exceed
+    # 16384 entries must keep the narrow chunks
+    Xb = rng.integers(0, b - 1, size=(20_000, 2)).astype(np.uint8)
+    fb = np.full(2, b - 1)
+    s4, cap4, _ = build_chunked_store(Xb, fb, b, entry_chunk=128,
+                                      auto_uniform=True)
+    assert s4.ent_bin.shape[1] == 128
+    assert cap4 > 1
+
+
 def test_kernel_pregathered_weights_identical():
     """entry_weights (the per-tree hoisted gathers, r5) must be exactly
     the in-call gather — same kernel inputs, bit-identical output."""

@@ -250,6 +250,14 @@ R05B = [
     ("pallas_ct W=32 compact",
      {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32,
       "extra": {"tpu_wave_compact": True}}),
+    # MXU sparse kernel after the r5 fixes (weight gathers hoisted to
+    # once/tree; auto-uniform one-dot-per-column layout): r4 measured
+    # 2.72 s/iter with ~185 ms/wave of gathers + ~19k tiny dots; the
+    # predicted floor is now the per-wave leaf-id gather (~46 ms) +
+    # ~3 ms kernel ~= 0.7 s/iter
+    ("bosch1Mx968 sparse_mxu w32 r5",
+     {"kind": "sparse", "n": 1_000_000, "width": 32, "timeout": 2700,
+      "extra": {"tpu_sparse": True, "tpu_sparse_kernel": True}}),
 ]
 
 
