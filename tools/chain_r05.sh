@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-5 measurement deck, armed while the tunnel is wedged (see
+# ROADMAP.md "Round-5 measurement deck" and BENCH_NOTES.md "Armed
+# decks" for the pre-registered decision rules).  Waits for the
+# tunnel, then runs:
+#   (0) bench.py FIRST — a fresh builder artifact lands early in the
+#       window, so even a brief window protects the headline number
+#   (1) the suite arms: bf16, spectator-compaction, ct-widening, the
+#       yahoo width-pathology probe (now == auto), score-update,
+#       everything-on, exact-order fallback pricing
+#   (2) 1M bf16 kernel A/Bs (r04p) and the Bosch attack stack (r05b:
+#       ct-wide / +compact / +bf16 / sparse_mxu-after-fixes)
+#   (3) the missing 10.5M wave parity arm
+#   (4) a final bench re-warm before releasing the chip
+cd /root/repo || exit 1
+LOG=/tmp/chain_r05.log
+log() { echo "[chain5] $(date -u +%F\ %T) $*" >> "$LOG"; }
+
+END=${CHAIN5_END_EPOCH:-$(( $(date +%s) + 28800 ))}
+left() { echo $(( END - $(date +%s) )); }
+
+stage() {  # stage <name> <cap_seconds> <cmd...>
+  local name=$1 cap=$2; shift 2
+  local l; l=$(left)
+  if [ "$l" -le 300 ]; then log "$name SKIPPED (budget spent)"; return; fi
+  [ "$cap" -gt "$l" ] && cap=$l
+  log "$name start (cap ${cap}s)"
+  timeout "$cap" "$@" ; log "$name rc=$?"
+}
+
+log "armed (end $(date -u -d @$END +%T))"
+while :; do
+  [ "$(left)" -le 600 ] && { log "tunnel never returned; idle-exit"; exit 0; }
+  timeout 150 python - <<'EOF' >/dev/null 2>&1 && break
+from lightgbm_tpu.utils.common import probe_device
+import sys
+sys.exit(0 if probe_device(timeout=120) == "tpu" else 1)
+EOF
+  sleep 120
+done
+log "tunnel ALIVE"
+
+stage bench0 2400 env BENCH_DEADLINE_S=2100 \
+  bash -c 'python bench.py > /tmp/bench_r05_early.json 2> /tmp/bench_r05_early.err'
+
+stage suite 13200 env SUITE_DEADLINE_S=12900 \
+  python tools/bench_suite.py higgs_bf16 higgs_compact epsilon_ct \
+  msltr_ct yahoo_w64 higgs_su higgs_fast higgs_xo
+
+stage ab2p 2700 env AB2_DEADLINE_S=2400 \
+  bash -c 'python tools/tpu_ab2.py 999424 --r04p > /tmp/ab2_r04p.out 2>&1'
+
+stage ab2b 6000 env AB2_DEADLINE_S=5700 \
+  bash -c 'python tools/tpu_ab2.py 999424 --r05b > /tmp/ab2_r05b.out 2>&1'
+
+stage paritywave 3600 env PARITY_N=10500000 PARITY_DEADLINE_S=3300 \
+  bash -c 'python tools/parity_flagship.py --wave-only > /tmp/parity_fs10m_wave.out 2>&1'
+
+stage bench9 2100 env BENCH_DEADLINE_S=1800 \
+  bash -c 'python bench.py > /tmp/bench_r05_final.json 2> /tmp/bench_r05_final.err'
+
+log "chain5 complete; chip released"
