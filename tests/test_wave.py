@@ -47,6 +47,36 @@ def test_band_adjusted_width_escapes_pathological_blocks():
     assert band_adjusted_width(64, 968, 64) == 64     # cap respected
 
 
+def test_band_escape_applies_in_serial_learner(monkeypatch):
+    """The learner applies the band escape to AUTO widths when the
+    pallas wave kernel will run (faked TPU backend): a 1200-col
+    255-leaf config's W=32 block (29.5 MB) sits in the band, so auto
+    resolves to W=64; an explicit width passes through untouched."""
+    import jax
+    from lightgbm_tpu.ops.learner import SerialTreeLearner
+    from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
+
+    rng = np.random.default_rng(23)
+    Xw = rng.normal(size=(600, 1200))
+    yw = (Xw[:, 0] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 255, "verbose": -1, "max_bin": 63,
+                  "enable_bundle": False})
+    td = TrainingData.from_matrix(Xw, label=yw, config=cfg)
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    try:
+        lrn = SerialTreeLearner(cfg, td)
+        assert lrn.hist_mode == "pallas_t"       # wide-F kernel
+        assert lrn.wave_width == 64              # escaped the band
+        cfg2 = Config({"num_leaves": 255, "verbose": -1, "max_bin": 63,
+                       "enable_bundle": False, "tpu_wave_width": 32})
+        lrn2 = SerialTreeLearner(cfg2, td)
+        assert lrn2.wave_width == 32             # explicit width wins
+    finally:
+        monkeypatch.undo()
+        make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+
+
 def _setup(categorical=False, efb=False):
     rng = np.random.default_rng(11)
     X = rng.normal(size=(N, F))

@@ -136,6 +136,43 @@ def test_compact_matches_full_pass_with_bagging():
     np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
 
 
+def test_compact_with_packed_bins():
+    """4-bit packing + compaction: the tier gathers COLUMNS of the
+    packed (ceil(F/2), N) Xt and the kernel unpacks per tile — the
+    combination must match the unpacked compacted run exactly."""
+    from lightgbm_tpu.ops.pack import pack4_host
+    rng = np.random.default_rng(11)
+    n = 6000
+    X = rng.normal(size=(n, F))
+    y = (X[:, 1] + np.cos(X[:, 4] * 2) + 0.4 * rng.normal(size=n) > 0.5)
+    cfg = Config({"num_leaves": 63, "min_data_in_leaf": 3,
+                  "max_bin": 15, "verbose": -1})
+    td = TrainingData.from_matrix(X, label=y.astype(np.float64),
+                                  config=cfg)
+    meta = FeatureMeta(num_bin=jnp.asarray(td.num_bin_arr),
+                       default_bin=jnp.asarray(td.default_bin_arr),
+                       is_categorical=jnp.asarray(td.is_categorical_arr))
+    params = build_split_params(cfg)
+    nb = int(td.num_bin_arr.max())
+    grad = jnp.asarray((0.5 - y).astype(np.float32))
+    hess = jnp.full(n, 0.25, jnp.float32)
+    rm = jnp.ones(n, jnp.float32)
+    fm = jnp.ones(td.num_features, dtype=bool)
+    Xd = jnp.asarray(td.binned)
+    Xp = jnp.asarray(pack4_host(np.asarray(td.binned)))
+    outs = []
+    for packed, Xin in ((0, Xd), (td.binned.shape[1], Xp)):
+        grow = make_wave_grow_fn(63, nb, meta, params, -1, wave_width=4,
+                                 hist_mode="pallas_ct", with_xt=True,
+                                 packed_cols=packed, compact=True,
+                                 pallas_interpret=True)
+        outs.append(jax.jit(grow)(Xin, grad, hess, rm, fm,
+                                  jnp.transpose(Xin)))
+    (t_u, l_u), (t_p, l_p) = outs
+    _trees_identical(t_u, t_p)
+    np.testing.assert_array_equal(np.asarray(l_u), np.asarray(l_p))
+
+
 def test_compact_config_reaches_serial_learner():
     """tpu_wave_compact threads from Config through the serial learner's
     wave-core statics (no-op off TPU, but the static must arrive)."""

@@ -136,6 +136,17 @@ SHAPES = {
     # currently gated to ncols*bin_pad <= 2048 — these arms supply the
     # wide-F datapoints; the W=16-epsilon / W=32-bosch pathology says
     # wide-F cells can surprise)
+    # expo_cat sits just past the ct auto bound (40 cols x 64-pad =
+    # 2560 > 2048) so it pays the pallas_t two-pass pipeline; this arm
+    # prices ct there — with the small per-wave work of 2M x 40, the
+    # saved partition pass is the biggest single lever the 3.9x shape
+    # has (VERDICT r4 weak #7)
+    "expo_ct": dict(n=2_000_000, f=40, cache_as="expo_cat", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "categorical_feature": ",".join(str(i) for i in range(10)),
+        "tpu_histogram_mode": "pallas_ct", "tpu_wave_width": 32},
+        warmup=2, measured=5, timeout=2700, n_cat=10, cardinality=100),
     "epsilon_ct": dict(n=400_000, f=2000, cache_as="epsilon", params={
         "objective": "binary", "metric": "auc", "num_leaves": 255,
         "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,

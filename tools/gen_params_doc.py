@@ -96,6 +96,12 @@ NOTES = {
                         "update engine (score += leaf_value[leaf_id]): "
                         "XLA gather, or the bit-equal Pallas "
                         "compare-select kernel; auto = gather",
+    "tpu_wave_compact": "true / false — spectator-row compaction for "
+                        "the fused pallas_ct wave kernel: late waves "
+                        "gather only the rows whose leaf is still "
+                        "splitting into capacity tiers (split "
+                        "structure unchanged; float fields can drift "
+                        "by f32 ulps at multi-tile N); opt-in",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
@@ -143,9 +149,9 @@ GROUPS = [
         "machine_list_file", "histogram_pool_size"]),
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
-        "tpu_wave_lookup", "tpu_histogram_mode", "tpu_hist_precision",
-        "tpu_score_update", "tpu_bin_pack", "tpu_sparse",
-        "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
+        "tpu_wave_lookup", "tpu_wave_compact", "tpu_histogram_mode",
+        "tpu_hist_precision", "tpu_score_update", "tpu_bin_pack",
+        "tpu_sparse", "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
         "tpu_profile_dir"]),
 ]
 
