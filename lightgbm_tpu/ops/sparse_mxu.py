@@ -191,11 +191,12 @@ def gather_entry_weights(store: ChunkedSparseStore, w3):
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
-                                             "interpret", "hilo"))
+                                             "interpret", "hilo",
+                                             "num_leaves"))
 def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
                               child_id, num_bins: int, num_cols: int,
                               interpret: bool = False, hilo: bool = True,
-                              entry_weights=None):
+                              entry_weights=None, num_leaves: int = 0):
     """(K, F, B, 3) histograms of the rows whose leaf is child_id[k],
     from nonzero entries only (fill slots zero — view reconstructs).
 
@@ -203,6 +204,10 @@ def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
     child_id: (K,) int32 target leaves, -1 entries yield zero histograms.
     entry_weights: optional pre-gathered (g_e, h_e, m_e) from
     gather_entry_weights — pass it from any per-wave loop (see there).
+    num_leaves > 0 narrows the leaf-id gather (the dominant per-wave
+    term after the weight hoist) to the smallest dtype holding the ids
+    — a 4x traffic cut at <=256 leaves IF the TPU gather is byte-bound
+    (index-bound would make it a wash; the r05b A/B decides).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -218,7 +223,13 @@ def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
     # per-entry row gathers, XLA-side: O(nnz) reads of the (N,) vectors.
     # Pad rows (id N) clip to N-1; their bin -1 zeroes the contribution.
     rows_flat = store.ent_row.reshape(-1)
-    lid_e = jnp.take(leaf_id, rows_flat, mode="clip").reshape(nc, e)
+    lid_src = leaf_id
+    if 0 < num_leaves <= 256:
+        lid_src = leaf_id.astype(jnp.uint8)
+    elif 0 < num_leaves <= 65536:
+        lid_src = leaf_id.astype(jnp.uint16)
+    lid_e = jnp.take(lid_src, rows_flat,
+                     mode="clip").reshape(nc, e).astype(jnp.int32)
     if entry_weights is None:
         entry_weights = gather_entry_weights(store, w3)
     g_e, h_e, m_e = entry_weights

@@ -281,7 +281,7 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                         and hist_dtype == jnp.float32):
                     return sparse_wave_histogram_mxu(
                         X, lid, w3, cid, hist_bins, Fc, hilo=hist_hilo,
-                        entry_weights=mxu_entry_w)
+                        entry_weights=mxu_entry_w, num_leaves=L)
                 return chunked_child_hists_ref(
                     X, lid, w3, cid, hist_bins, Fc, L)
             slot_tbl = jnp.full(L, -1, jnp.int32).at[

@@ -162,6 +162,26 @@ def test_kernel_pregathered_weights_identical():
                                     b, X.shape[1], interpret=True,
                                     entry_weights=ew)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+    # the narrow leaf-id gather (uint8 at <=256 leaves) is exact too
+    got8 = sparse_wave_histogram_mxu(store, jnp.asarray(leaf_id),
+                                     jnp.asarray(w3), jnp.asarray(cid),
+                                     b, X.shape[1], interpret=True,
+                                     entry_weights=ew, num_leaves=L)
+    np.testing.assert_array_equal(np.asarray(got8), np.asarray(base))
+    # and the uint16 branch (257..65536 leaves), with ids above 255 so
+    # a uint8-wrap bug could not hide
+    lid_hi = leaf_id.astype(np.int32) + 300
+    cid_hi = np.where(cid >= 0, cid + 300, cid).astype(np.int32)
+    base16 = sparse_wave_histogram_mxu(store, jnp.asarray(lid_hi),
+                                       jnp.asarray(w3),
+                                       jnp.asarray(cid_hi), b,
+                                       X.shape[1], interpret=True)
+    got16 = sparse_wave_histogram_mxu(store, jnp.asarray(lid_hi),
+                                      jnp.asarray(w3),
+                                      jnp.asarray(cid_hi), b,
+                                      X.shape[1], interpret=True,
+                                      entry_weights=ew, num_leaves=512)
+    np.testing.assert_array_equal(np.asarray(got16), np.asarray(base16))
 
 
 def test_kernel_nondefault_chunk_block():
