@@ -398,16 +398,18 @@ class SerialTreeLearner:
                     self.wave_width, ncols, _bin_pad(nbins))
         if bool(config.tpu_wave_compact):
             from .wave import pallas_wave_active as _pwa2
-            if not (growth == "wave" and self.hist_mode == "pallas_ct"
+            if not (growth == "wave"
+                    and self.hist_mode in ("pallas_ct", "pallas_t")
                     and _pwa2(self.hist_mode, self.dtype)):
                 # explicit opt-ins must not be dropped silently (same
                 # policy as tpu_sparse / tpu_bin_pack); the kernel gate
                 # (_pwa2) also covers non-TPU backends and f64
                 Log.warning("tpu_wave_compact=true ignored: requires "
-                            "wave growth with the fused pallas_ct "
-                            "kernel on TPU with f32 accumulation "
-                            "(resolved growth=%s, histogram mode=%s, "
-                            "backend=%s)", growth, self.hist_mode,
+                            "wave growth with a transposed Pallas wave "
+                            "kernel (pallas_ct/pallas_t) on TPU with "
+                            "f32 accumulation (resolved growth=%s, "
+                            "histogram mode=%s, backend=%s)",
+                            growth, self.hist_mode,
                             jax.default_backend())
         hp = str(config.tpu_hist_precision).strip().lower()
         if hp not in ("auto", "hilo", "bf16"):

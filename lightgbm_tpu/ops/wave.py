@@ -202,11 +202,13 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
     # on-chip A/B to pallas_t (tools/AB_RESULTS.md, BENCH_NOTES.md r4)
     pallas_transposed = hist_mode in ("pallas_t", "pallas_ct")
     pallas_fused = hist_mode == "pallas_ct"
-    # spectator-row compaction rides the fused kernel only, and only
+    # spectator-row compaction rides the transposed kernels (the fused
+    # ct tier calls the fused kernel; the t tier runs a vectorized
+    # partition over the gathered slab then the t kernel), and only
     # under serial execution (per-shard divergent tier choices inside
     # shard_map would be legal — no collectives in the branches — but
     # have no measurement yet)
-    compact = bool(compact and pallas_fused and use_pallas_hist
+    compact = bool(compact and pallas_transposed and use_pallas_hist
                    and psum_axis is None)
 
     def maybe_psum(x):
@@ -498,10 +500,39 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                                      fill_value=-2)
                     w3_c = jnp.take(w3, idx, axis=0, mode="fill",
                                     fill_value=0.0)
-                    new_c, hist = wave_partition_hist_pallas_ct(
-                        xt_c, lid_c, w3_c, cid, cols, psrc, hist_bins,
-                        bundled=has_bundle, logical_cols=packed_cols,
-                        hilo=hist_hilo, interpret=pallas_interpret)
+                    if pallas_fused:
+                        new_c, hist = wave_partition_hist_pallas_ct(
+                            xt_c, lid_c, w3_c, cid, cols, psrc,
+                            hist_bins, bundled=has_bundle,
+                            logical_cols=packed_cols, hilo=hist_hilo,
+                            interpret=pallas_interpret)
+                    else:
+                        # pallas_t tier: the partition over the
+                        # gathered slab is ONE masked reduction — the
+                        # compact (W, 10) lookup per row, the split
+                        # column from a (Fc, cap) masked sum over Xt_c
+                        # (unpacked in place when 4-bit), then the
+                        # shared routing algebra — followed by the t
+                        # histogram kernel on the updated ids
+                        from .pallas_wave import (_unpack4_t,
+                                                  wave_histogram_pallas_t)
+                        pm = lid_c[None, :] == psrc[:, None]   # (W,cap)
+                        r = jnp.sum(
+                            jnp.where(pm[:, :, None], cols[:, None, :],
+                                      0.0), axis=0)            # (cap,10)
+                        xi = xt_c.astype(jnp.int32)
+                        if packed_cols:
+                            xi = _unpack4_t(xi, Fc)
+                        cj = r[:, 1].astype(jnp.int32)
+                        f_io = jnp.arange(Fc, dtype=jnp.int32)
+                        colv = jnp.sum(
+                            jnp.where(cj[None, :] == f_io[:, None],
+                                      xi, 0), axis=0)          # (cap,)
+                        new_c = route_rows(r, colv, lid_c)
+                        hist = wave_histogram_pallas_t(
+                            xt_c, new_c, w3_c, cid, hist_bins,
+                            logical_cols=packed_cols, hilo=hist_hilo,
+                            interpret=pallas_interpret)
                     return (leaf_id.at[idx].set(new_c, mode="drop"),
                             hist)
                 return run

@@ -406,8 +406,8 @@ class Config:
         # gather ran at ~8 cycles/row).  auto = gather until the pallas
         # path's on-chip validation lands.
         "tpu_score_update": ("str", "auto"),
-        # spectator-row compaction for the fused wave kernel
-        # (tpu_histogram_mode=pallas_ct): late waves touch only the rows
+        # spectator-row compaction for the transposed wave kernels
+        # (tpu_histogram_mode=pallas_ct/pallas_t): late waves touch only the rows
         # whose leaf is still splitting (~35% of row work at the flagship
         # recipe is rows whose leaf is final — measured frontier
         # occupancy, ROADMAP.md r4), so the wave gathers the active rows

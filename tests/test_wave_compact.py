@@ -46,14 +46,14 @@ def _setup(num_leaves, n=N):
 
 
 def _run(compact, num_leaves, wave_width, row_mult=None,
-         exact_order=False, n=N):
+         exact_order=False, n=N, hist_mode="pallas_ct"):
     cfg, td, meta, grad, hess = _setup(num_leaves, n=n)
     params = build_split_params(cfg)
     nb = int(td.num_bin_arr.max())
     X = jnp.asarray(td.binned)
     grow = make_wave_grow_fn(num_leaves, nb, meta, params, -1,
                              wave_width=wave_width,
-                             hist_mode="pallas_ct", with_xt=True,
+                             hist_mode=hist_mode, with_xt=True,
                              exact_order=exact_order,
                              compact=compact, pallas_interpret=True)
     rm = (jnp.ones(n, jnp.float32) if row_mult is None
@@ -80,12 +80,14 @@ def _trees_identical(a, b):
                                       err_msg=field)
 
 
+@pytest.mark.parametrize("hist_mode", ["pallas_ct", "pallas_t"])
 @pytest.mark.parametrize("wave_width", [1, 4])
-def test_compact_matches_full_pass(wave_width):
+def test_compact_matches_full_pass(wave_width, hist_mode):
     """62 splits over 6000 rows: late waves are far under the 1024-row
-    tier, so the ladder's gathered branches run for real."""
-    t_full, l_full = _run(False, 63, wave_width)
-    t_comp, l_comp = _run(True, 63, wave_width)
+    tier, so the ladder's gathered branches run for real — under both
+    the fused ct tier and the vector-partition t tier."""
+    t_full, l_full = _run(False, 63, wave_width, hist_mode=hist_mode)
+    t_comp, l_comp = _run(True, 63, wave_width, hist_mode=hist_mode)
     assert int(t_full.num_leaves) == 63
     _trees_identical(t_full, t_comp)
     np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
@@ -136,10 +138,12 @@ def test_compact_matches_full_pass_with_bagging():
     np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l_comp))
 
 
-def test_compact_with_packed_bins():
+@pytest.mark.parametrize("hist_mode", ["pallas_ct", "pallas_t"])
+def test_compact_with_packed_bins(hist_mode):
     """4-bit packing + compaction: the tier gathers COLUMNS of the
-    packed (ceil(F/2), N) Xt and the kernel unpacks per tile — the
-    combination must match the unpacked compacted run exactly."""
+    packed (ceil(F/2), N) Xt and unpacks in place (kernel-side for ct,
+    partition-side via the shared _unpack4_t for t) — the combination
+    must match the unpacked compacted run exactly."""
     from lightgbm_tpu.ops.pack import pack4_host
     rng = np.random.default_rng(11)
     n = 6000
@@ -163,7 +167,7 @@ def test_compact_with_packed_bins():
     outs = []
     for packed, Xin in ((0, Xd), (td.binned.shape[1], Xp)):
         grow = make_wave_grow_fn(63, nb, meta, params, -1, wave_width=4,
-                                 hist_mode="pallas_ct", with_xt=True,
+                                 hist_mode=hist_mode, with_xt=True,
                                  packed_cols=packed, compact=True,
                                  pallas_interpret=True)
         outs.append(jax.jit(grow)(Xin, grad, hess, rm, fm,

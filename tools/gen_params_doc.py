@@ -97,11 +97,12 @@ NOTES = {
                         "XLA gather, or the bit-equal Pallas "
                         "compare-select kernel; auto = gather",
     "tpu_wave_compact": "true / false — spectator-row compaction for "
-                        "the fused pallas_ct wave kernel: late waves "
-                        "gather only the rows whose leaf is still "
-                        "splitting into capacity tiers (split "
-                        "structure unchanged; float fields can drift "
-                        "by f32 ulps at multi-tile N); opt-in",
+                        "the transposed Pallas wave kernels "
+                        "(pallas_ct / pallas_t): late waves gather "
+                        "only the rows whose leaf is still splitting "
+                        "into capacity tiers (split structure "
+                        "unchanged; float fields can drift by f32 "
+                        "ulps at multi-tile N); opt-in",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
