@@ -136,6 +136,15 @@ SHAPES = {
     # currently gated to ncols*bin_pad <= 2048 — these arms supply the
     # wide-F datapoints; the W=16-epsilon / W=32-bosch pathology says
     # wide-F cells can surprise)
+    # wide-F compaction arm (r5): epsilon under pallas_t + the
+    # vector-partition compact tier — the wide-shape form of
+    # higgs_compact; run when a window allows (not in the armed chain)
+    "epsilon_tc": dict(n=400_000, f=2000, cache_as="epsilon", params={
+        "objective": "binary", "metric": "auc", "num_leaves": 255,
+        "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "tpu_histogram_mode": "pallas_t", "tpu_wave_width": 32,
+        "tpu_wave_compact": True},
+        warmup=2, measured=5, timeout=2700),
     # expo_cat sits just past the ct auto bound (40 cols x 64-pad =
     # 2560 > 2048) so it pays the pallas_t two-pass pipeline; this arm
     # prices ct there — with the small per-wave work of 2M x 40, the

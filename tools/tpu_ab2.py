@@ -246,9 +246,14 @@ R05B = [
       "extra": {"tpu_growth": "wave", "tpu_wave_compact": True,
                 "tpu_hist_precision": "bf16"}}),
     # flagship compaction A/B at 1M (the cheap proxy the suite's
-    # higgs_compact arm confirms at 10.5M)
+    # higgs_compact arm confirms at 10.5M), plus the t-tier variant
+    # (the vector-partition tier wide-F shapes would use if the ct
+    # bound does not widen)
     ("pallas_ct W=32 compact",
      {"kind": "dense", "n": 0, "mode": "pallas_ct", "width": 32,
+      "extra": {"tpu_wave_compact": True}}),
+    ("pallas_t  W=32 compact",
+     {"kind": "dense", "n": 0, "mode": "pallas_t", "width": 32,
       "extra": {"tpu_wave_compact": True}}),
     # MXU sparse kernel after the r5 fixes (weight gathers hoisted to
     # once/tree; auto-uniform one-dot-per-column layout): r4 measured
