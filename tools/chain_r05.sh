@@ -45,7 +45,7 @@ stage bench0 2400 env BENCH_DEADLINE_S=2100 \
 
 stage suite 16800 env SUITE_DEADLINE_S=16500 \
   python tools/bench_suite.py higgs_bf16 higgs_compact epsilon_ct \
-  msltr_ct yahoo_w64 expo_ct higgs_su higgs_fast higgs_xo
+  epsilon_tc msltr_ct yahoo_w64 expo_ct higgs_su higgs_fast higgs_xo
 
 stage ab2p 2700 env AB2_DEADLINE_S=2400 \
   bash -c 'python tools/tpu_ab2.py 999424 --r04p > /tmp/ab2_r04p.out 2>&1'
