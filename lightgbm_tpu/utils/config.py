@@ -375,7 +375,12 @@ class Config:
         # the reference GPU's default single-precision histograms
         # (docs/GPU-Performance.md:127-130, gpu_use_dp=false).  Split
         # ROUTING is unaffected (exact f32 compares) — only histogram
-        # sums, and through them split choices, can drift.  auto = hilo.
+        # sums, and through them split choices, can drift.  auto = bf16
+        # where the Pallas wave kernels run under single-chip wave
+        # growth (promoted round 5: 1.63x at the 10.5M flagship, AUC
+        # within 1.0e-4 — tools/BENCH_SUITE.md higgs_bf16); exact
+        # growth, data-parallel execution, and every non-pallas engine
+        # stay hilo.  Set 'hilo' to force the exact split everywhere.
         "tpu_hist_precision": ("str", "auto"),
         # row-chunk size of the wave engine's fused partition+histogram
         # sweep; smaller chunks shrink the (chunk, F*B) one-hot tile
@@ -402,9 +407,11 @@ class Config:
         # 'auto' | 'gather' | 'pallas' — the train-side score update
         # (score += leaf_value[leaf_id]).  'gather' = XLA small-table
         # gather; 'pallas' = compare-select kernel (ops/predict.py,
-        # bit-equal, measured target ~10x at 10.5M rows where the XLA
-        # gather ran at ~8 cycles/row).  auto = gather until the pallas
-        # path's on-chip validation lands.
+        # bit-equal, measured faster at the 10.5M flagship: 1.45 vs
+        # 1.30 it/s with EXACTLY equal AUC — tools/BENCH_SUITE.md
+        # higgs_su).  auto = pallas (promoted round 5); the dispatch
+        # falls back to the gather off-TPU, above 512 leaves, or on
+        # f64 scores (tpu_use_dp).
         "tpu_score_update": ("str", "auto"),
         # spectator-row compaction for the transposed wave kernels
         # (tpu_histogram_mode=pallas_ct/pallas_t): late waves touch only the rows

@@ -239,8 +239,17 @@ def test_score_update_engine_validation():
         lgb.train({"objective": "binary", "num_boost_round": 1,
                    "tpu_score_update": "vmem", "verbose": -1},
                   lgb.Dataset(X, label=y))
-    # explicit gather trains (the auto default path)
+    # explicit gather trains
     bst = lgb.train({"objective": "binary", "num_boost_round": 2,
                      "tpu_score_update": "gather", "verbose": -1},
                     lgb.Dataset(X, label=y))
     assert bst.predict(X).shape == (300,)
+    # round-5 promoted auto (BENCH_NOTES.md "Armed decks", measured
+    # bit-equal + faster at the 10.5M flagship): auto resolves to the
+    # pallas engine — the dispatch in ops/predict.py still falls back
+    # to the gather off-TPU / at num_leaves>512 / on f64 scores, so
+    # training on CPU must keep working
+    bst2 = lgb.train({"objective": "binary", "num_boost_round": 2,
+                      "verbose": -1}, lgb.Dataset(X, label=y))
+    assert bst2._gbdt._score_engine == "pallas"
+    assert bst2.predict(X).shape == (300,)

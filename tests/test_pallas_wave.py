@@ -181,8 +181,10 @@ def test_auto_hist_mode_resolution(monkeypatch):
         td = TrainingData.from_matrix(X, label=y, config=cfg)
         return SerialTreeLearner(cfg, td)
 
-    # CPU truth (this process): scatter
+    # CPU truth (this process): scatter; auto precision stays hi/lo
+    # off-TPU (no pallas kernel ever runs the bf16 product there)
     assert learner_for().hist_mode == "scatter"
+    assert learner_for().hist_hilo is True
 
     # tpu_hist_precision is validated unconditionally (like
     # tpu_histogram_mode); bf16 resolves the kernels' hilo flag off
@@ -204,16 +206,37 @@ def test_auto_hist_mode_resolution(monkeypatch):
     # 10.5M x 28 and 1M x 28 — learner.py auto block)
     assert learner_for().hist_mode == "pallas_ct"
     assert learner_for(tpu_growth="exact").hist_mode == "onehot"
-    # wider than the fused-kernel bound but inside the VMEM gate: the
-    # measured pallas_t stays (40 cols * 64-pad = 2560 > 2048; a broken
-    # bound silently shipping the unmeasured ct kernel to epsilon/msltr
-    # -class shapes must fail here)
+    # round-5 promoted auto precision (BENCH_NOTES.md "Armed decks"):
+    # auto -> single-bf16-product where the pallas wave kernel runs;
+    # exact growth (parity anchor) and explicit hilo stay hi/lo.  A
+    # refactor reverting the auto resolution must fail here, not ship
+    # a silent 1.63x flagship slowdown.
+    assert learner_for().hist_hilo is False
+    assert learner_for(tpu_growth="exact").hist_hilo is True
+    assert learner_for(tpu_hist_precision="hilo").hist_hilo is True
+    # ...and scoped to serial execution: the DP learner (psum_axis set)
+    # keeps hi/lo — every bf16 gate was a single-chip serial arm
+    cfg_dp = Config({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1})
+    td_dp = TrainingData.from_matrix(X, label=y, config=cfg_dp)
+    assert SerialTreeLearner(cfg_dp, td_dp,
+                             psum_axis="d").hist_hilo is True
+    # inside the round-5 widened fused-kernel bound (40 cols * 64-pad =
+    # 2560 <= 8768): ct measured a 15% win at exactly this shape
+    # (tools/BENCH_SUITE.md expo_ct 4.07 vs expo_cat 3.53 it/s)
     Xm = rng.normal(size=(600, 40))
     ym = (Xm[:, 0] > 0).astype(np.float64)
     cfgm = Config({"objective": "binary", "num_leaves": 7,
                    "max_bin": 63, "verbose": -1})
     tdm = TrainingData.from_matrix(Xm, label=ym, config=cfgm)
-    assert SerialTreeLearner(cfgm, tdm).hist_mode == "pallas_t"
+    assert SerialTreeLearner(cfgm, tdm).hist_mode == "pallas_ct"
+    # past the bound but inside the VMEM gate: pallas_t stays (200 cols
+    # * 64-pad = 12800 > 8768 — epsilon-class wide-F measured ct 5.6x
+    # SLOWER, tools/BENCH_SUITE.md epsilon_ct)
+    Xm2 = rng.normal(size=(600, 200))
+    ym2 = (Xm2[:, 0] > 0).astype(np.float64)
+    tdm2 = TrainingData.from_matrix(Xm2, label=ym2, config=cfgm)
+    assert SerialTreeLearner(cfgm, tdm2).hist_mode == "pallas_t"
     assert learner_for(tpu_use_dp=True).hist_mode == "onehot"
     sp = learner_for(tpu_sparse=True)
     assert sp.hist_mode == "sparse"    # sparse store keeps its own path

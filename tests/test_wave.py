@@ -35,13 +35,15 @@ def test_wave_width_auto_policy():
 
 
 def test_band_adjusted_width_escapes_pathological_blocks():
-    """Auto widths must not land in the measured 12-30 MB hist-block
+    """Auto widths must not land in the measured 18-30 MB hist-block
     band (epsilon W16 ran 43x slower than W32, bosch W32 10.8x slower
-    than W64 — BENCH_NOTES.md r4)."""
+    than W64 — BENCH_NOTES.md r4).  Round 5 narrowed the lower bound
+    past yahoo's 17.2 MB cell: its W=64 escape measured 3.2x SLOWER
+    (tools/BENCH_SUITE.md yahoo_w64), so that cell stays at W=32."""
     from lightgbm_tpu.ops.learner import band_adjusted_width
     assert band_adjusted_width(16, 2000, 64) == 32    # epsilon: 24.6 MB
     assert band_adjusted_width(32, 968, 64) == 64     # bosch: 23.8 MB
-    assert band_adjusted_width(32, 699, 64) == 64     # yahoo: 17.2 MB
+    assert band_adjusted_width(32, 699, 64) == 32     # yahoo: 17.2 MB stays
     assert band_adjusted_width(32, 28, 64) == 32      # flagship: 0.7 MB
     assert band_adjusted_width(32, 2000, 64) == 32    # already past: 49 MB
     assert band_adjusted_width(64, 968, 64) == 64     # cap respected
