@@ -324,11 +324,22 @@ def child():
     enable_compilation_cache()
 
     params = flagship_params()
+    # the measurement instrument is the obs timeline (lightgbm_tpu/obs):
+    # obs_timing=iter fences once per iteration, so the per-iteration
+    # records sum to the fenced end-to-end time and the driver-witnessed
+    # number and the builder's come from the same JSONL
+    obs_path = "/tmp/bench_obs_%d.jsonl" % os.getpid()
+    try:
+        os.unlink(obs_path)
+    except OSError:
+        pass
+    params.update({"obs_events_path": obs_path, "obs_timing": "iter"})
     # the one-core data gen + binning costs minutes per attempt; cache the
     # BINNED dataset (atomic publish) so tunnel-wedge retries skip it.
     # Any cache problem falls back to a fresh build — the cache must never
-    # be able to kill the measurement.
-    cache = cache_path(params)
+    # be able to kill the measurement.  Keyed on the flagship params only:
+    # the per-pid obs path must not invalidate it.
+    cache = cache_path(flagship_params())
     train_set = None
     if os.path.exists(cache):
         try:
@@ -363,7 +374,25 @@ def child():
         gbdt.train_one_iter(None, None, False)
     jax.block_until_ready(gbdt._score_dev)
     dt = time.time() - t0
-    ips = MEASURED / dt
+
+    # headline number from the emitted timeline (the same instrument the
+    # driver and any postmortem read); wall-clock only as the fallback if
+    # the telemetry is somehow unusable — the measurement must not die on
+    # an instrumentation bug
+    gbdt._obs.close()
+    try:
+        from lightgbm_tpu.obs import read_events
+        evs = read_events(obs_path)
+        run = [e for e in evs if e["run"] == evs[-1]["run"]]
+        iter_recs = [e for e in run if e["ev"] == "iter" and e["fenced"]]
+        assert len(iter_recs) >= WARMUP + MEASURED
+        dt_obs = sum(e["time_s"] for e in iter_recs[-MEASURED:])
+        assert dt_obs > 0
+        ips = MEASURED / dt_obs
+    except Exception as e:
+        print("bench: timeline unusable (%s); falling back to wall clock"
+              % e, file=sys.stderr, flush=True)
+        ips = MEASURED / dt
 
     # sanity: training must actually be learning
     auc = gbdt.get_eval_at(0)[0]
@@ -385,10 +414,48 @@ def child():
     }))
 
 
+def dry():
+    """Tier-1-safe telemetry smoke (CI: JAX_PLATFORMS=cpu python bench.py
+    --dry): train a tiny shape with obs enabled and assert the emitted
+    JSONL parses as a schema-valid timeline — so a telemetry regression
+    is caught before the next on-chip bench window, not during it."""
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import read_events
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2000, 8)).astype(np.float32)
+    w = rng.normal(size=8)
+    y = (X @ w > 0).astype(np.float64)
+    obs_path = "/tmp/bench_dry_obs_%d.jsonl" % os.getpid()
+    try:
+        os.unlink(obs_path)
+    except OSError:
+        pass
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
+              "verbose": -1, "obs_events_path": obs_path,
+              "obs_timing": "iter", "obs_memory_every": 2}
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    evs = read_events(obs_path)          # validates every record
+    kinds = [e["ev"] for e in evs]
+    for need in ("run_header", "iter", "compile", "memory", "run_end"):
+        assert need in kinds, "timeline missing %r events" % need
+    iter_recs = [e for e in evs if e["ev"] == "iter"]
+    assert len(iter_recs) == 5, "expected 5 iter records, got %d" \
+        % len(iter_recs)
+    assert all(e["time_s"] > 0 and e["fenced"] for e in iter_recs)
+    print(json.dumps({"status": "dry_ok", "events": len(evs),
+                      "iters": len(iter_recs), "path": obs_path}))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child()
     elif len(sys.argv) > 1 and sys.argv[1] == "--prepare-cache":
         prepare_cache()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--dry":
+        dry()
     else:
         main()

@@ -12,7 +12,7 @@ from .basic import Booster, Dataset
 from .engine import cv, train
 from .utils.log import LightGBMError
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, record_telemetry, reset_parameter)
 
 try:
     from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
@@ -32,4 +32,5 @@ __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "train", "cv", "LightGBMError",
            "EarlyStopException", "early_stopping", "print_evaluation",
-           "record_evaluation", "reset_parameter"] + _SKLEARN_EXPORTS + _PLOT_EXPORTS
+           "record_evaluation", "record_telemetry",
+           "reset_parameter"] + _SKLEARN_EXPORTS + _PLOT_EXPORTS

@@ -551,6 +551,19 @@ class Booster:
             raw = self._gbdt.valid_score_host(data_idx - 1)
         return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
 
+    def telemetry(self) -> list:
+        """The run observer's in-memory event timeline (lightgbm_tpu/obs)
+        as a list of event dicts — empty unless an ``obs_*`` param enabled
+        telemetry.  The list is a snapshot copy; docs/Observability.md
+        describes the schema."""
+        return list(self._gbdt._obs.timeline)
+
+    def finalize_telemetry(self) -> None:
+        """Emit the run_end summary event and flush/close the JSONL
+        writer.  Called by engine.train()/cv() after the boosting loop;
+        idempotent, and safe when telemetry is disabled."""
+        self._gbdt._obs.close()
+
     def reset_parameter(self, params: dict) -> "Booster":
         """LGBM_BoosterResetParameter semantics: rebuild the running config
         like GBDT::ResetConfig.  learning_rate alone takes a fast path (it

@@ -67,6 +67,31 @@ def record_evaluation(eval_result: dict):
     return callback
 
 
+def record_telemetry(records: list):
+    """Mirror the run observer's event timeline into ``records`` after
+    every iteration (requires an ``obs_*`` param to enable telemetry —
+    otherwise the list stays empty).  The list is REPLACED with the full
+    timeline each call, so it is always a consistent snapshot — fold
+    boosters under cv() append interleaved and are distinguished by each
+    record's ``run`` id.  See docs/Observability.md for the schema."""
+    if not isinstance(records, list):
+        raise TypeError("records should be a list")
+    records.clear()
+
+    def callback(env: CallbackEnv):
+        timeline = env.model.telemetry()
+        if timeline and isinstance(timeline[0], list):
+            # CVBooster broadcasts telemetry() across folds
+            merged = []
+            for fold in timeline:
+                merged.extend(fold)
+            timeline = merged
+        records.clear()
+        records.extend(timeline)
+    callback.order = 25
+    return callback
+
+
 def _schedule_arity(fn) -> int:
     """1 or 2: how many positional args a reset_parameter schedule takes.
 

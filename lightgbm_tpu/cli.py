@@ -109,6 +109,12 @@ def run_train(cfg: Config) -> None:
         if profile_dir:
             import jax
             jax.profiler.stop_trace()   # keep the trace on failures too
+        # finalize run telemetry (lightgbm_tpu/obs): run_end + flush, so a
+        # failed run still leaves a readable timeline
+        booster._obs.close()
+    if cfg.obs_events_path:
+        Log.info("Telemetry timeline -> %s (summarize with "
+                 "tools/trace_summary.py)", cfg.obs_events_path)
     booster.save_model_to_file(cfg.output_model)
     Log.info("Finished training")
 

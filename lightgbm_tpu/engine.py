@@ -122,6 +122,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     booster.best_score = collections.defaultdict(dict)
     for dataset_name, eval_name, score, _ in evaluation_result_list or []:
         booster.best_score[dataset_name][eval_name] = score
+    booster.finalize_telemetry()
     return booster
 
 
@@ -275,4 +276,5 @@ def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
             for k in list(results.keys()):
                 results[k] = results[k][:e.best_iteration + 1]
             break
+    cvbooster.finalize_telemetry()     # broadcasts across folds
     return dict(results)

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..io.dataset import TrainingData
 from ..metrics import Metric
+from ..obs import NULL_OBSERVER, observer_from_config
 from ..objectives import ObjectiveFunction, load_objective_from_string
 from ..ops.learner import SerialTreeLearner, materialize_tree
 from ..ops import predict as dev_predict
@@ -83,6 +84,7 @@ class GBDT:
         self.best_msg: List[List[str]] = []
         self._score_dev: Optional[jnp.ndarray] = None
         self._score_host: Optional[np.ndarray] = None
+        self._obs = NULL_OBSERVER
         self.num_tree_per_iteration = 1
         if objective is not None:
             self.num_tree_per_iteration = objective.num_tree_per_iteration()
@@ -109,6 +111,29 @@ class GBDT:
         # back to the XLA gather otherwise, so 'auto' is safe to
         # resolve unconditionally here.
         self._score_engine = "pallas" if se == "auto" else se
+
+    def _reset_observer(self, config: Config) -> None:
+        """Build the run observer (lightgbm_tpu/obs) for this training
+        dataset and emit the run header.  All-default obs params leave the
+        shared NULL observer in place — the hot path then pays one
+        attribute load and an empty call per hook, no fencing, no event
+        objects."""
+        prev = getattr(self, "_obs", NULL_OBSERVER)
+        if prev.enabled:
+            prev.close()
+        self._obs = observer_from_config(config)
+        if self._obs.enabled:
+            devices = [{"id": int(d.id), "platform": str(d.platform),
+                        "kind": str(getattr(d, "device_kind", ""))}
+                       for d in jax.devices()]
+            self._obs.run_header(
+                backend=str(jax.default_backend()), devices=devices,
+                params={k: str(v) for k, v in self.config.raw.items()},
+                context=self.learner.obs_info())
+            collective_info = getattr(self.learner, "collective_info", None)
+            if collective_info is not None:
+                self._obs.event("collectives", **collective_info())
+        self.learner.set_observer(self._obs)
 
     def reset_config(self, config: Config) -> None:
         """GBDT::ResetConfig (gbdt.cpp:64-74): re-read training
@@ -150,6 +175,9 @@ class GBDT:
                 device_packed_cols=getattr(old, "packed_cols", 0))
         else:
             self.learner = create_tree_learner(config, self.train_data)
+        # re-attach the run observer to the rebuilt learner so entry-point
+        # timing survives a reset_parameter callback
+        self.learner.set_observer(self._obs)
         # bagging state (gbdt.cpp ResetBaggingConfig, :134-160)
         self.bag_data_cnt = self.num_data
         self.row_mult = None
@@ -173,6 +201,7 @@ class GBDT:
         self.learner = create_tree_learner(config, train_data)
         self.score_dtype = self.learner.dtype
         self._resolve_score_engine(config)
+        self._reset_observer(config)
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
@@ -367,6 +396,9 @@ class GBDT:
         """GBDT::TrainOneIter (gbdt.cpp:339-458); returns True to stop."""
         cfg = self.config
         k = self.num_tree_per_iteration
+        obs = self._obs
+        it0 = self.iter
+        obs.iter_begin(it0)
         # boost from average (gbdt.cpp:341-362)
         if (not self.models and cfg.boost_from_average
                 and not self.has_init_score and self.num_class <= 1
@@ -404,6 +436,8 @@ class GBDT:
 
         # bagging / GOSS may need host gradients and may rescale them
         g_dev, h_dev = self._bagging_with_grad(self.iter, g_dev, h_dev)
+        # "boost" = objective gradients + bagging (+ first-iter stub tree)
+        obs.lap("boost", (g_dev, h_dev))
 
         num_leaves_this_iter = []
         for tid in range(k):
@@ -411,6 +445,10 @@ class GBDT:
                 dev_tree, leaf_id = self.learner.train_device(g_dev[tid],
                                                               h_dev[tid],
                                                               self.row_mult)
+                # "grow" = the fused histogram+split+partition XLA program
+                # (one jitted entry; finer decomposition needs a profiler
+                # window — see docs/Observability.md)
+                obs.lap("grow", leaf_id)
                 # device score updates (train via partition, valids via
                 # traversal) — all async
                 self._score_dev = self._score_dev.at[tid].set(
@@ -420,6 +458,7 @@ class GBDT:
                         jnp.asarray(self.shrinkage_rate, self.score_dtype),
                         engine=self._score_engine))
                 self._invalidate_train()
+                obs.lap("partition", self._score_dev)
                 ta = dev_predict.traversal_from_grow(dev_tree)
                 scaled = ta._replace(leaf_value=ta.leaf_value)
                 for vi in range(len(self.valid_data)):
@@ -431,6 +470,8 @@ class GBDT:
                                         self.score_dtype),
                             self.learner.bundle_arrays))
                     self._invalidate_valid(vi)
+                if self.valid_data:
+                    obs.lap("update", self._valid_score_dev[-1])
                 self.models.append(None)
                 self._models_dev.append(dev_tree)
                 self._models_shrink.append(self.shrinkage_rate)
@@ -463,10 +504,15 @@ class GBDT:
             should_continue = False
         if not should_continue:
             self._pop_degenerate_iterations()
+            obs.iter_end(it0, value=self._score_dev, stopped=True)
             return True
         self.iter += 1
         if is_eval:
-            return self.eval_and_check_early_stopping()
+            stop = self.eval_and_check_early_stopping()
+            obs.lap("eval")
+            obs.iter_end(it0, value=self._score_dev)
+            return stop
+        obs.iter_end(it0, value=self._score_dev)
         return False
 
     def _bagging_with_grad(self, it, g_dev, h_dev):

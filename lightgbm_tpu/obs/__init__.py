@@ -1,0 +1,65 @@
+"""Run telemetry: structured event timeline, phase timers, profiler windows.
+
+Every training run can self-instrument (the per-phase breakdowns that
+"GPU-acceleration for Large-scale Tree Boosting" and "XGBoost: Scalable
+GPU Accelerated Learning" ground their claims in, built into the loop):
+
+* ``events``  — versioned JSONL event emitter (run header with params /
+  backend / device topology, per-iteration phase records, compile events,
+  memory snapshots) plus the ``RunObserver`` facade the training loop
+  drives and the allocation-free ``NULL_OBSERVER`` it holds by default;
+* ``timers``  — phase clocks and per-entry-point timers that fence with
+  ``jax.block_until_ready`` for device-accurate timings and split the
+  first-call (compile) cost from steady-state execute cost;
+* ``memory``  — per-device ``memory_stats()`` snapshots at a cadence;
+* ``profile`` — programmatic ``jax.profiler.trace`` windows over exactly
+  the configured iterations (``obs_trace_iters=a:b`` + ``obs_trace_dir``).
+
+Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
+``obs_memory_every``, ``obs_trace_iters``, ``obs_trace_dir``,
+``obs_flush_every``.  See docs/Observability.md for the schema.
+"""
+from __future__ import annotations
+
+from .events import (NULL_OBSERVER, SCHEMA_VERSION, EventWriter,
+                     NullObserver, RunObserver, read_events, validate_event)
+from ..utils.log import Log
+
+__all__ = ["NULL_OBSERVER", "NullObserver", "RunObserver", "EventWriter",
+           "SCHEMA_VERSION", "read_events", "validate_event",
+           "observer_from_config"]
+
+_TIMING_MODES = ("auto", "phase", "iter", "off")
+
+
+def observer_from_config(config):
+    """RunObserver from the ``obs_*`` config params, or NULL_OBSERVER when
+    nothing is enabled — the disabled path must cost one attribute check.
+
+    ``obs_timing`` semantics: 'phase' fences every phase boundary with
+    ``jax.block_until_ready`` (device-accurate per-phase times; breaks the
+    async pipeline, so it costs throughput); 'iter' fences once per
+    iteration (accurate per-iteration totals, phases are dispatch-only —
+    the bench protocol); 'off' records wall times without any fencing
+    (dispatch cost only); 'auto' = 'phase'.
+    """
+    events_path = str(getattr(config, "obs_events_path", "") or "")
+    trace_iters = str(getattr(config, "obs_trace_iters", "") or "")
+    memory_every = int(getattr(config, "obs_memory_every", 0) or 0)
+    if not events_path and not trace_iters and memory_every <= 0:
+        return NULL_OBSERVER
+    timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
+    if timing not in _TIMING_MODES:
+        Log.fatal("Unknown obs_timing %s (expected auto/phase/iter/off)",
+                  timing)
+    if timing == "auto":
+        timing = "phase"
+    trace_dir = str(getattr(config, "obs_trace_dir", "") or "")
+    if trace_iters and not trace_dir:
+        Log.fatal("obs_trace_iters requires obs_trace_dir (where the "
+                  "jax.profiler trace is written)")
+    return RunObserver(events_path=events_path, timing=timing,
+                       memory_every=memory_every, trace_iters=trace_iters,
+                       trace_dir=trace_dir,
+                       flush_every=int(getattr(config, "obs_flush_every",
+                                               16) or 16))

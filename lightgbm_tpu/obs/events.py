@@ -1,0 +1,242 @@
+"""Structured JSONL event timeline for training runs.
+
+One line per event, append-only, versioned via ``schema`` in the run
+header.  Every record carries ``ev`` (type), ``t`` (unix time) and
+``run`` (random id) — multiple runs may share one file (cv folds,
+repeated bench children) and readers group by ``run``.
+
+Event types and their required keys (beyond ev/t/run):
+
+=============  =========================================================
+run_header     schema, backend, devices, params, context, timing
+iter           it, time_s, phases, fenced
+compile        entry, first_call_s, fenced
+memory         it, devices
+trace_window   action, dir, it
+collectives    learner (plus learner-specific topology/byte estimates)
+run_end        iters, phase_totals, entries
+=============  =========================================================
+
+``RunObserver`` is the facade the training loop drives; ``NULL_OBSERVER``
+is the shared disabled instance — every method is a no-op and the hot
+path pays one attribute check and an empty call, with no fencing and no
+event objects allocated.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .memory import MemorySampler, device_memory_stats
+from .profile import TraceWindow
+from .timers import EntryTimers, PhaseClock, fence
+from ..utils.log import Log
+
+SCHEMA_VERSION = 1
+
+# ev -> keys that must be present (beyond the common ev/t/run)
+_REQUIRED = {
+    "run_header": ("schema", "backend", "devices", "params", "context",
+                   "timing"),
+    "iter": ("it", "time_s", "phases", "fenced"),
+    "compile": ("entry", "first_call_s", "fenced"),
+    "memory": ("it", "devices"),
+    "trace_window": ("action", "dir", "it"),
+    "collectives": ("learner",),
+    "run_end": ("iters", "phase_totals", "entries"),
+}
+
+
+def validate_event(rec):
+    """Raise ValueError unless ``rec`` is a schema-valid event dict."""
+    if not isinstance(rec, dict):
+        raise ValueError("event is not a dict: %r" % (rec,))
+    ev = rec.get("ev")
+    if ev not in _REQUIRED:
+        raise ValueError("unknown event type %r" % (ev,))
+    for key in ("t", "run"):
+        if key not in rec:
+            raise ValueError("event %r missing %r" % (ev, key))
+    missing = [k for k in _REQUIRED[ev] if k not in rec]
+    if missing:
+        raise ValueError("event %r missing keys %s" % (ev, missing))
+    if ev == "run_header" and rec["schema"] != SCHEMA_VERSION:
+        raise ValueError("unsupported schema version %r" % (rec["schema"],))
+    return rec
+
+
+def read_events(path, validate=True):
+    """Parse a JSONL event file into a list of dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if validate:
+                validate_event(rec)
+            out.append(rec)
+    return out
+
+
+class EventWriter:
+    """Append-mode JSONL writer, flushed every ``flush_every`` events
+    (and on close) so a killed run still leaves a readable timeline."""
+
+    def __init__(self, path, flush_every=16):
+        self.path = str(path)
+        self.flush_every = max(1, int(flush_every))
+        self._f = None
+        self._pending = 0
+
+    def emit(self, rec):
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(rec, default=str) + "\n")
+        self._pending += 1
+        if self._pending >= self.flush_every:
+            self._f.flush()
+            self._pending = 0
+
+    def close(self):
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+            self._pending = 0
+
+
+class NullObserver:
+    """The disabled observer: every hook is a no-op.  A single shared
+    instance (NULL_OBSERVER) sits on GBDT/learner objects by default so
+    the enabled check is one attribute load."""
+
+    enabled = False
+    timeline = ()
+
+    def event(self, ev, **fields):
+        pass
+
+    def iter_begin(self, it):
+        pass
+
+    def lap(self, name, value=None):
+        pass
+
+    def iter_end(self, it, value=None, **fields):
+        pass
+
+    def entry_start(self):
+        return 0.0
+
+    def entry_end(self, name, t0, value=None):
+        pass
+
+    def memory_snapshot(self, it):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_OBSERVER = NullObserver()
+
+
+class RunObserver(NullObserver):
+    """Live observer: drives the phase clock, entry timers, memory
+    sampler and trace window, and appends every event to both the
+    in-memory ``timeline`` (exposed via Booster.telemetry() and the
+    record_telemetry callback) and the JSONL writer."""
+
+    enabled = True
+
+    def __init__(self, events_path="", timing="phase", memory_every=0,
+                 trace_iters="", trace_dir="", flush_every=16):
+        self.run_id = os.urandom(4).hex()
+        self.timing = timing
+        self.timeline = []
+        self._writer = (EventWriter(events_path, flush_every)
+                        if events_path else None)
+        self._clock = PhaseClock(fence_laps=(timing == "phase"))
+        self._entries = EntryTimers()
+        self._memory = MemorySampler(memory_every)
+        self._trace = TraceWindow(trace_iters, trace_dir)
+        self._iters = 0
+        self._closed = False
+
+    # -- raw emission --------------------------------------------------
+    def event(self, ev, **fields):
+        rec = {"ev": ev, "t": time.time(), "run": self.run_id}
+        rec.update(fields)
+        self.timeline.append(rec)
+        if self._writer is not None:
+            self._writer.emit(rec)
+        return rec
+
+    def run_header(self, backend, devices, params, context):
+        self.event("run_header", schema=SCHEMA_VERSION, backend=backend,
+                   devices=devices, params=params, context=context,
+                   timing=self.timing)
+
+    # -- per-iteration hooks ------------------------------------------
+    def iter_begin(self, it):
+        self._trace.maybe_start(it, self)
+        self._clock.begin()
+
+    def lap(self, name, value=None):
+        self._clock.lap(name, value)
+
+    def iter_end(self, it, value=None, **fields):
+        if self.timing in ("phase", "iter"):
+            fence(value)
+        total, phases = self._clock.end()
+        self._iters += 1
+        self.event("iter", it=it, time_s=total, phases=phases,
+                   fenced=(self.timing in ("phase", "iter")), **fields)
+        devices = self._memory.maybe(it)
+        if devices is not None:
+            self.event("memory", it=it, devices=devices)
+        self._trace.maybe_stop(it, self)
+
+    # -- jitted entry points ------------------------------------------
+    def entry_start(self):
+        return time.perf_counter()
+
+    def entry_end(self, name, t0, value=None):
+        fenced = self.timing == "phase"
+        if fenced:
+            fence(value)
+        dt = time.perf_counter() - t0
+        if self._entries.record(name, dt):
+            self.event("compile", entry=name, first_call_s=dt, fenced=fenced)
+
+    # -- misc ----------------------------------------------------------
+    def memory_snapshot(self, it):
+        self.event("memory", it=it, devices=device_memory_stats())
+
+    def flush(self):
+        if self._writer is not None and self._writer._f is not None:
+            self._writer._f.flush()
+            self._writer._pending = 0
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._trace.force_stop(self)
+        self.event("run_end", iters=self._iters,
+                   phase_totals=self._clock.totals(),
+                   entries=self._entries.summary())
+        if self._writer is not None:
+            self._writer.close()
+        if self._writer is not None:
+            Log.debug("obs: wrote %d events to %s", len(self.timeline),
+                      self._writer.path)

@@ -1,0 +1,43 @@
+"""Per-device memory snapshots at a configurable cadence.
+
+TPU/GPU runtimes expose ``Device.memory_stats()`` (bytes in use, peak,
+limit); the CPU backend returns None — snapshots then carry only the
+device identity so the schema stays uniform across backends.  All JAX
+calls live inside functions: importing this module must not initialize a
+backend (tests pin that ``import lightgbm_tpu`` is backend-clean).
+"""
+from __future__ import annotations
+
+_KEEP = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+         "largest_alloc_size", "num_allocs")
+
+
+def device_memory_stats():
+    """One snapshot row per local device; stats keys only when the
+    backend provides them."""
+    import jax
+    rows = []
+    for d in jax.local_devices():
+        row = {"id": int(d.id), "platform": str(d.platform)}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for k in _KEEP:
+                if k in stats:
+                    row[k] = int(stats[k])
+        rows.append(row)
+    return rows
+
+
+class MemorySampler:
+    """Yields a snapshot every ``every`` iterations (0 disables)."""
+
+    def __init__(self, every):
+        self.every = int(every)
+
+    def maybe(self, it):
+        if self.every > 0 and it % self.every == 0:
+            return device_memory_stats()
+        return None

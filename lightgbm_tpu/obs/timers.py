@@ -1,0 +1,123 @@
+"""Phase clocks and per-entry-point timers for the training loop.
+
+JAX dispatch is asynchronous: a host-side ``time.perf_counter()`` around a
+jitted call measures dispatch cost, not device time.  Device-accurate
+timing requires fencing the result with ``jax.block_until_ready`` — which
+also breaks the async pipeline, so every timer here takes fencing as a
+parameter and the caller (RunObserver) decides per the ``obs_timing``
+mode.  All timers are plain-Python and allocation-light; none of them is
+on the disabled path (NULL_OBSERVER never constructs one).
+"""
+from __future__ import annotations
+
+import time
+
+
+def fence(value):
+    """Block until ``value`` (array / pytree / None) is device-complete.
+
+    None-safe and forgiving: values that are not JAX types (python
+    scalars, numpy arrays) pass through untouched, so call sites can hand
+    over whatever the phase produced without type checks.
+    """
+    if value is None:
+        return
+    try:
+        import jax
+        jax.block_until_ready(value)
+    except Exception:       # non-jax value, or backend already torn down
+        pass
+
+
+class PhaseClock:
+    """Splits one iteration into named laps (boost / grow / partition /
+    update / eval) and accumulates per-phase totals across iterations.
+
+    ``begin()`` starts the iteration, ``lap(name, value)`` closes the
+    current phase (optionally fencing ``value`` first), ``end(value)``
+    closes the iteration and returns ``(total_s, {phase: s})``.  Repeated
+    laps with the same name within one iteration accumulate (the tree
+    loop laps "grow" once per tree).
+    """
+
+    def __init__(self, fence_laps=True):
+        self.fence_laps = bool(fence_laps)
+        self._totals = {}           # phase -> cumulative seconds, all iters
+        self._phases = {}           # phase -> seconds, current iteration
+        self._t_begin = 0.0
+        self._t_last = 0.0
+
+    def begin(self):
+        self._phases = {}
+        self._t_begin = self._t_last = time.perf_counter()
+
+    def lap(self, name, value=None):
+        if self.fence_laps:
+            fence(value)
+        now = time.perf_counter()
+        self._phases[name] = self._phases.get(name, 0.0) + (now - self._t_last)
+        self._t_last = now
+
+    def end(self, value=None):
+        fence(value)
+        now = time.perf_counter()
+        total = now - self._t_begin
+        # time since the last lap (or begin) that no lap() claimed
+        tail = now - self._t_last
+        if tail > 0.0 and self._phases:
+            self._phases["other"] = self._phases.get("other", 0.0) + tail
+        phases = self._phases
+        self._phases = {}
+        for k, v in phases.items():
+            self._totals[k] = self._totals.get(k, 0.0) + v
+        return total, phases
+
+    def totals(self):
+        return dict(self._totals)
+
+
+class EntryTimers:
+    """Compile-vs-execute split per jitted entry point.
+
+    The first fenced call of a jitted function pays trace + XLA compile
+    (+ one execute); steady-state calls pay execute only.  ``record``
+    returns True exactly once per entry name — the caller emits a
+    ``compile`` event for that call — and folds every later call into
+    execute statistics.
+    """
+
+    def __init__(self):
+        self._entries = {}   # name -> stats dict
+
+    def record(self, name, dt):
+        st = self._entries.get(name)
+        if st is None:
+            self._entries[name] = {"first_s": dt, "exec_n": 0,
+                                   "exec_total_s": 0.0, "exec_min_s": 0.0,
+                                   "exec_max_s": 0.0}
+            return True
+        st["exec_n"] += 1
+        st["exec_total_s"] += dt
+        if st["exec_n"] == 1 or dt < st["exec_min_s"]:
+            st["exec_min_s"] = dt
+        if dt > st["exec_max_s"]:
+            st["exec_max_s"] = dt
+        return False
+
+    def summary(self):
+        out = {}
+        for name, st in self._entries.items():
+            n = st["exec_n"]
+            out[name] = {
+                "first_s": st["first_s"],
+                "exec_n": n,
+                "exec_total_s": st["exec_total_s"],
+                "exec_mean_s": (st["exec_total_s"] / n) if n else 0.0,
+                "exec_min_s": st["exec_min_s"],
+                "exec_max_s": st["exec_max_s"],
+                # compile estimate: first call minus a steady-state execute
+                "compile_est_s": max(0.0, st["first_s"] -
+                                     ((st["exec_total_s"] / n) if n
+                                      else 0.0)),
+            }
+        return out

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..io.dataset import TrainingData
 from ..models.tree import Tree
+from ..obs import NULL_OBSERVER
 from ..utils.config import Config
 from ..utils.random import Random
 from .grow import (BundleArrays, TreeArrays, default_row_capacities,
@@ -194,6 +195,10 @@ def build_split_params(config: Config) -> SplitParams:
 
 
 class SerialTreeLearner:
+    # run observer (lightgbm_tpu/obs); a class-level NULL default keeps
+    # every constructor untouched and the disabled path allocation-free
+    _obs = NULL_OBSERVER
+
     def __init__(self, config: Config, train_data: TrainingData,
                  psum_axis: Optional[str] = None, device_data=None,
                  device_row_pad: int = 0, device_packed_cols: int = 0,
@@ -694,6 +699,28 @@ class SerialTreeLearner:
         # (serial_tree_learner.cpp:40-96 Init + :257-275 BeforeTrain)
         self._feature_rng = Random(config.feature_fraction_seed)
 
+    # -------------------------------------------------------- observability
+    def set_observer(self, obs) -> None:
+        self._obs = obs
+
+    def obs_info(self) -> dict:
+        """Static run-header context: which engines/knobs this learner
+        resolved to (the 'auto' params post-resolution)."""
+        return {
+            "learner": type(self).__name__,
+            "growth": getattr(self, "growth", ""),
+            "hist_mode": getattr(self, "hist_mode", ""),
+            "wave_width": int(getattr(self, "wave_width", 0) or 0),
+            "wave_order": getattr(self, "wave_order", ""),
+            "wave_lookup": getattr(self, "wave_lookup", ""),
+            "hist_hilo": bool(getattr(self, "hist_hilo", True)),
+            "packed_cols": int(getattr(self, "packed_cols", 0) or 0),
+            "num_leaves": int(self.num_leaves),
+            "num_bins": int(self.num_bins),
+            "dtype": jnp.dtype(self.dtype).name,
+            "cache_hists": bool(getattr(self, "cache_hists", False)),
+        }
+
     # ------------------------------------------------------------ internals
     def sample_feature_mask(self):
         f = self.train_data.num_features
@@ -725,8 +752,11 @@ class SerialTreeLearner:
                 [grad, jnp.zeros(self._row_pad, self.dtype)])
             hess = jnp.concatenate(
                 [hess, jnp.zeros(self._row_pad, self.dtype)])
+        obs = self._obs
+        t0 = obs.entry_start()
         tree, leaf_id = self._grow(self.X, grad, hess, row_mult,
                                    feature_mask)
+        obs.entry_end("tree_grow", t0, (tree, leaf_id))
         if self._row_pad:
             leaf_id = leaf_id[:self.train_data.num_data]
         return tree, leaf_id

@@ -264,6 +264,31 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _grow_kwargs(self, n_shards):
         return {}
 
+    def collective_info(self):
+        """Static topology + per-collective byte ESTIMATES for the run
+        header.  The host cannot time XLA collectives (they live inside
+        the one jitted grow program) — measured collective time needs an
+        obs_trace_iters profiler window; these numbers size the traffic.
+        Histograms are (grad, hess, count) triples per (feature, bin)."""
+        dtype_bytes = jnp.dtype(self.dtype).itemsize
+        f = max(self.train_data.num_features, 1)
+        info = {"learner": type(self).__name__, "axis": DATA_AXIS,
+                "n_devices": int(self.mesh.devices.size),
+                "n_processes": int(self._nproc),
+                "global_rows": int(self._global_rows),
+                "estimates": True}
+        if self.growth == "wave":
+            w = int(self.wave_width)
+            info["psum"] = {"what": "wave histogram block (W splits "
+                                    "per collective)",
+                            "per_wave_bytes":
+                                f * self.num_bins * 3 * w * dtype_bytes}
+        else:
+            info["psum"] = {"what": "per-leaf histogram",
+                            "per_leaf_bytes":
+                                f * self.num_bins * 3 * dtype_bytes}
+        return info
+
     def _dummy_tree_spec(self):
         # a TreeArrays-shaped pytree of None leaves for out_specs mapping
         from ..ops.grow import TreeArrays
@@ -304,7 +329,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
         args = (self.X, grad, hess, row_mult, feature_mask)
         if self._Xt is not None:
             args += (self._Xt,)
+        obs = self._obs
+        t0 = obs.entry_start()
         tree, leaf_id = self._grow(*args)
+        obs.entry_end("tree_grow", t0, (tree, leaf_id))
         if self._nproc > 1:
             return tree, leaf_id     # global, matches global score arrays
         return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id
@@ -323,6 +351,16 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
     def _grow_kwargs(self, n_shards):
         return {"voting_k": int(self.config.top_k),
                 "num_voting_machines": int(n_shards)}
+
+    def collective_info(self):
+        info = super().collective_info()
+        top_k = int(self.config.top_k)
+        info["psum"] = {"what": "PV-Tree voted histograms (top_k "
+                                "features per leaf)",
+                        "per_leaf_bytes": top_k * self.num_bins * 3
+                        * jnp.dtype(self.dtype).itemsize,
+                        "top_k": top_k}
+        return info
 
 
 class FeatureParallelTreeLearner(SerialTreeLearner):
@@ -393,6 +431,21 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         if self._fpad:
             mask = jnp.concatenate([mask, jnp.zeros(self._fpad, bool)])
         return mask
+
+    def collective_info(self):
+        """Per-split traffic: one packed-SplitInfo all_gather (the
+        Allreduce(MaxReducer) analog) + one row-bitmask psum.  Estimates
+        only — see DataParallelTreeLearner.collective_info."""
+        n_shards = int(self.mesh.devices.size)
+        return {"learner": type(self).__name__, "axis": FEATURE_AXIS,
+                "n_devices": n_shards, "n_processes": 1,
+                "global_rows": int(self.train_data.num_data),
+                "estimates": True,
+                "allgather": {"what": "packed SplitInfo per split",
+                              "per_split_bytes": 13 * 4 * n_shards},
+                "psum": {"what": "row-bitmask split re-execution",
+                         "per_split_bytes":
+                             int(self.train_data.num_data) * 4}}
 
 
 def create_tree_learner(config: Config, train_data: TrainingData,

@@ -93,6 +93,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "num_classes": "num_class",
     "unbalanced_sets": "is_unbalance",
     "bagging_fraction_seed": "bagging_seed",
+    "obs_events_file": "obs_events_path",
+    "obs_events": "obs_events_path",
+    "obs_profile_iters": "obs_trace_iters",
+    "obs_profile_dir": "obs_trace_dir",
+    "obs_memory_freq": "obs_memory_every",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -135,6 +140,9 @@ PARAMETER_SET = {
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
     "tpu_sparse_kernel", "tpu_hist_precision", "tpu_score_update",
     "tpu_wave_compact",
+    # observability (lightgbm_tpu/obs/)
+    "obs_events_path", "obs_timing", "obs_memory_every",
+    "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -425,6 +433,32 @@ class Config:
         # reassociation) — pinned vs the full-N pass in
         # tests/test_wave_compact.py.  Off until the on-chip A/B lands.
         "tpu_wave_compact": ("bool", False),
+        # observability (lightgbm_tpu/obs/): setting any of
+        # obs_events_path / obs_trace_iters / obs_memory_every turns the
+        # run observer on; all-defaults leaves the NULL observer in place
+        # (no fencing, no event objects on the hot path).
+        # JSONL event timeline destination (docs/Observability.md);
+        # append-mode, one run header + per-iteration records per run.
+        "obs_events_path": ("str", ""),
+        # 'auto' | 'phase' | 'iter' | 'off' — fencing policy for the
+        # phase timers.  'phase' fences every phase boundary with
+        # jax.block_until_ready (device-accurate per-phase times; breaks
+        # async pipelining).  'iter' fences once per iteration (accurate
+        # totals, dispatch-only phases — the bench protocol).  'off'
+        # never fences (dispatch cost only).  auto = phase.
+        "obs_timing": ("str", "auto"),
+        # emit a per-device memory_stats() snapshot every N iterations
+        # (0 = off; CPU backend reports device identity only)
+        "obs_memory_every": ("int", 0),
+        # 'a:b' — open a jax.profiler trace window at iteration a and
+        # close it after iteration b-1 (python-range semantics); captures
+        # a perfetto trace of exactly the steady-state iterations.
+        # Requires obs_trace_dir.
+        "obs_trace_iters": ("str", ""),
+        # destination directory of the obs_trace_iters profiler window
+        "obs_trace_dir": ("str", ""),
+        # flush the JSONL writer every N events (crash-tolerant timeline)
+        "obs_flush_every": ("int", 16),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -17,10 +17,19 @@ class Log:
     # verbosity semantics match the reference: <0 fatal-only, 0 +warning,
     # 1 +info (default), >1 +debug   (src/io/config.cpp verbosity mapping)
     _level = 1
+    _stream = None          # None -> sys.stderr (resolved at write time)
 
     @classmethod
     def reset_level(cls, verbosity: int) -> None:
         cls._level = verbosity
+
+    @classmethod
+    def set_stream(cls, stream):
+        """Redirect log output to ``stream`` (None restores stderr).
+        Returns the previous stream so callers/tests can restore it."""
+        prev = cls._stream
+        cls._stream = stream
+        return prev
 
     @classmethod
     def debug(cls, msg: str, *args) -> None:
@@ -42,8 +51,9 @@ class Log:
         text = (msg % args) if args else msg
         raise LightGBMError(text)
 
-    @staticmethod
-    def _write(level: str, msg: str, args) -> None:
+    @classmethod
+    def _write(cls, level: str, msg: str, args) -> None:
         text = (msg % args) if args else msg
-        sys.stderr.write("[LightGBM-TPU] [%s] %s\n" % (level, text))
-        sys.stderr.flush()
+        stream = cls._stream if cls._stream is not None else sys.stderr
+        stream.write("[LightGBM-TPU] [%s] %s\n" % (level, text))
+        stream.flush()

@@ -279,6 +279,7 @@ def child(name):
         "mode": lrn.hist_mode, "growth": lrn.growth,
         "order": getattr(lrn, "wave_order", "-"),
         "W": int(getattr(lrn, "wave_width", 0)),
+        "source": "obs_timeline",       # dt from the emitted telemetry
         "wall": time.time() - t_load}), flush=True)
 
 

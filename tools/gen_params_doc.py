@@ -113,6 +113,20 @@ NOTES = {
                    "prediction (f64-exact routing as int compares; auto "
                    "= device for >=100k-row batches on TPU)",
     "tpu_profile_dir": "write a jax.profiler trace per training run",
+    "obs_events_path": "run telemetry: write a structured JSONL event "
+                       "timeline (run header, per-iteration phase times, "
+                       "compile-vs-execute split, memory snapshots) — "
+                       "see Observability.md",
+    "obs_timing": "auto / phase / iter / off — telemetry fencing policy: "
+                  "phase fences every phase boundary (device-accurate, "
+                  "breaks pipelining), iter fences once per iteration "
+                  "(the bench protocol), off never fences; auto = phase",
+    "obs_memory_every": "emit per-device memory_stats() snapshots every "
+                        "N iterations (0 = off)",
+    "obs_trace_iters": "a:b — open a jax.profiler trace window over "
+                       "iterations [a, b) (requires obs_trace_dir)",
+    "obs_trace_dir": "destination of the obs_trace_iters profiler window",
+    "obs_flush_every": "flush the JSONL event writer every N events",
 }
 
 GROUPS = [
@@ -154,6 +168,9 @@ GROUPS = [
         "tpu_hist_precision", "tpu_score_update", "tpu_bin_pack",
         "tpu_sparse", "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
         "tpu_profile_dir"]),
+    ("Observability", [
+        "obs_events_path", "obs_timing", "obs_memory_every",
+        "obs_trace_iters", "obs_trace_dir", "obs_flush_every"]),
 ]
 
 

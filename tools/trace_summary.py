@@ -1,14 +1,21 @@
-"""Summarize a jax.profiler trace directory into a ranked op-time table.
+"""Summarize a jax.profiler trace directory OR an obs JSONL timeline.
 
 There is no TensorBoard/Perfetto UI in this image, so the flagship
 residue analysis (ROADMAP.md: ~130 ms/wave outside the histogram
-kernel) needs a programmatic reader.  jax.profiler.trace() writes a
-Perfetto-format ``*.trace.json.gz`` under
-``<outdir>/plugins/profile/<run>/``; this tool aggregates complete
-('ph' == 'X') events per track, ranks device-side op time, and prints
-the top offenders plus per-track totals.
+kernel) needs a programmatic reader.  Two input kinds:
+
+* a profiler trace directory — jax.profiler.trace() writes a
+  Perfetto-format ``*.trace.json.gz`` under
+  ``<outdir>/plugins/profile/<run>/``; aggregates complete ('ph' == 'X')
+  events per track, ranks device-side op time, prints top offenders;
+* a ``.jsonl`` event timeline written by the run observer
+  (``obs_events_path``, lightgbm_tpu/obs) — prints the run header, the
+  per-phase table, the compile-vs-execute split per jitted entry point,
+  and the peak device memory.  ``--csv`` emits the per-phase and
+  per-entry rows as CSV instead (for the bench artifacts directory).
 
 Usage:  python tools/trace_summary.py /tmp/tpu_trace_1m [top_n]
+        python tools/trace_summary.py /tmp/run_events.jsonl [--csv]
 """
 import collections
 import glob
@@ -35,8 +42,94 @@ def load_events(trace_dir):
     return path, data.get("traceEvents", [])
 
 
+def summarize_jsonl(path, csv=False, out=None):
+    """Summarize the LAST run recorded in an obs event timeline."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.obs import read_events
+    out = out if out is not None else sys.stdout
+    events = read_events(path)
+    if not events:
+        raise SystemExit("no events in %s" % path)
+    run = events[-1]["run"]
+    events = [e for e in events if e["run"] == run]
+    header = next((e for e in events if e["ev"] == "run_header"), None)
+    iters = [e for e in events if e["ev"] == "iter"]
+    compiles = [e for e in events if e["ev"] == "compile"]
+    run_end = next((e for e in events if e["ev"] == "run_end"), None)
+
+    phase_totals = collections.Counter()
+    for e in iters:
+        for k, v in e["phases"].items():
+            phase_totals[k] += v
+    total_s = sum(e["time_s"] for e in iters)
+    entries = (run_end or {}).get("entries", {})
+
+    if csv:
+        w = out.write
+        w("kind,name,total_s,mean_s,count,extra\n")
+        for k, v in phase_totals.most_common():
+            w("phase,%s,%.6f,%.6f,%d,\n" % (k, v, v / max(len(iters), 1),
+                                            len(iters)))
+        for name, st in sorted(entries.items()):
+            w("entry_compile,%s,%.6f,%.6f,1,first_call\n"
+              % (name, st["first_s"], st["first_s"]))
+            w("entry_execute,%s,%.6f,%.6f,%d,steady_state\n"
+              % (name, st["exec_total_s"], st["exec_mean_s"],
+                 st["exec_n"]))
+        return
+
+    w = lambda s="": out.write(s + "\n")
+    w("timeline: %s  (run %s)" % (path, run))
+    if header is not None:
+        ctx = header.get("context", {})
+        w("backend: %s  devices: %d  timing: %s" % (
+            header.get("backend"), len(header.get("devices", [])),
+            header.get("timing")))
+        w("learner: %s" % (", ".join(
+            "%s=%s" % (k, ctx[k]) for k in sorted(ctx))))
+    fenced = all(e.get("fenced") for e in iters) if iters else False
+    w("\n== per-phase time over %d iterations (%s) ==" % (
+        len(iters), "fenced" if fenced else "dispatch-only — NOT "
+        "device-accurate (obs_timing=off)"))
+    w("  %10s %10s %7s  %s" % ("total_s", "mean_ms", "share", "phase"))
+    for k, v in phase_totals.most_common():
+        w("  %10.3f %10.2f %6.1f%%  %s"
+          % (v, 1e3 * v / max(len(iters), 1),
+             100.0 * v / total_s if total_s else 0.0, k))
+    w("  %10.3f %10.2f %7s  total" % (
+        total_s, 1e3 * total_s / max(len(iters), 1), ""))
+
+    if entries or compiles:
+        w("\n== compile vs execute per jitted entry point ==")
+        w("  %-12s %12s %12s %12s %8s" % ("entry", "first_call_s",
+                                          "compile_est_s", "exec_mean_s",
+                                          "exec_n"))
+        for name, st in sorted(entries.items()):
+            w("  %-12s %12.3f %12.3f %12.4f %8d"
+              % (name, st["first_s"], st.get("compile_est_s", 0.0),
+                 st["exec_mean_s"], st["exec_n"]))
+
+    peaks = {}
+    for e in events:
+        if e["ev"] != "memory":
+            continue
+        for d in e["devices"]:
+            if "peak_bytes_in_use" in d or "bytes_in_use" in d:
+                cur = d.get("peak_bytes_in_use", d.get("bytes_in_use", 0))
+                peaks[d["id"]] = max(peaks.get(d["id"], 0), cur)
+    if peaks:
+        w("\n== peak device memory ==")
+        for did, b in sorted(peaks.items()):
+            w("  device %d: %.1f MiB" % (did, b / 2**20))
+
+
 def main():
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
+    if trace_dir.endswith(".jsonl") or (os.path.isfile(trace_dir)
+                                        and not trace_dir.endswith(".gz")):
+        summarize_jsonl(trace_dir, csv="--csv" in sys.argv[2:])
+        return
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
 
     path, events = load_events(trace_dir)
