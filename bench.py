@@ -435,19 +435,32 @@ def dry():
         pass
     params = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
               "verbose": -1, "obs_events_path": obs_path,
-              "obs_timing": "iter", "obs_memory_every": 2}
+              "obs_timing": "iter", "obs_memory_every": 2,
+              "obs_health": "warn", "obs_metrics_every": 2}
     lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
 
     evs = read_events(obs_path)          # validates every record
     kinds = [e["ev"] for e in evs]
-    for need in ("run_header", "iter", "compile", "memory", "run_end"):
+    for need in ("run_header", "iter", "compile", "memory", "health",
+                 "metrics", "run_end"):
         assert need in kinds, "timeline missing %r events" % need
     iter_recs = [e for e in evs if e["ev"] == "iter"]
     assert len(iter_recs) == 5, "expected 5 iter records, got %d" \
         % len(iter_recs)
     assert all(e["time_s"] > 0 and e["fenced"] for e in iter_recs)
+    health = [e for e in evs if e["ev"] == "health"]
+    bad = [e for e in health if e["status"] != "ok"]
+    assert not bad, "healthy dry run emitted non-ok health events: %r" % bad
+    metric_recs = [e for e in evs if e["ev"] == "metrics"]
+    scrape = metric_recs[-1]["scrape"]
+    for need in ("lgbm_trees_built_total", "lgbm_train_iterations_total"):
+        assert need in scrape and scrape[need]["value"] > 0, \
+            "metrics snapshot missing %r" % need
+    end = [e for e in evs if e["ev"] == "run_end"][-1]
+    assert end.get("status") == "ok", "clean dry run must end status=ok"
     print(json.dumps({"status": "dry_ok", "events": len(evs),
-                      "iters": len(iter_recs), "path": obs_path}))
+                      "iters": len(iter_recs), "health": len(health),
+                      "metrics": len(metric_recs), "path": obs_path}))
 
 
 if __name__ == "__main__":

@@ -558,11 +558,13 @@ class Booster:
         describes the schema."""
         return list(self._gbdt._obs.timeline)
 
-    def finalize_telemetry(self) -> None:
+    def finalize_telemetry(self, status: str = "ok") -> None:
         """Emit the run_end summary event and flush/close the JSONL
-        writer.  Called by engine.train()/cv() after the boosting loop;
-        idempotent, and safe when telemetry is disabled."""
-        self._gbdt._obs.close()
+        writer.  Called by engine.train()/cv() after the boosting loop —
+        with ``status="aborted"`` on the exception path, so a crashed run
+        still ends with a parseable timeline; idempotent, and safe when
+        telemetry is disabled."""
+        self._gbdt._obs.close(status=status)
 
     def reset_parameter(self, params: dict) -> "Booster":
         """LGBM_BoosterResetParameter semantics: rebuild the running config
@@ -719,10 +721,26 @@ class Booster:
                 is_reshape: bool = True, pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0):
-        """Predict rows (numpy/pandas/CSR/CSC or a data file path)."""
+        """Predict rows (numpy/pandas/CSR/CSC or a data file path).
+
+        The serving choke point: per-request latency and batch size land
+        in the process metrics registry (lightgbm_tpu/obs/metrics.py) —
+        the C API and file-path predicts all funnel through here.
+        """
         if isinstance(data, Dataset):
             raise TypeError("Cannot use Dataset instance for prediction, "
                             "please use raw data instead")
+        import time as _time
+        from .obs.metrics import observe_predict
+        t0 = _time.perf_counter()
+        out = self._predict_data(data, num_iteration, raw_score, pred_leaf,
+                                 data_has_header)
+        observe_predict(np.asarray(out).shape[0] if np.ndim(out) else 1,
+                        _time.perf_counter() - t0)
+        return out
+
+    def _predict_data(self, data, num_iteration, raw_score, pred_leaf,
+                      data_has_header):
         if isinstance(data, str):
             from .io import parser as _parser
             parsed = _parser.parse_file(data, has_header=data_has_header)

@@ -213,12 +213,31 @@ def LGBM_BoosterGetNumClasses(handle: int) -> int:
 
 def LGBM_BoosterPredictForMat(handle: int, data, predict_type: int = 0,
                               num_iteration: int = -1):
-    """predict_type: 0 normal, 1 raw score, 2 leaf index (c_api.h)."""
+    """predict_type: 0 normal, 1 raw score, 2 leaf index (c_api.h).
+
+    The serving entry: per-request latency and batch-size land in the
+    metrics registry via Booster.predict (lightgbm_tpu/obs/metrics.py;
+    the CSR/CSC variants route through here per dense chunk).  Scrape
+    via LGBM_MetricsScrape.
+    """
     bst = _get(handle)
     return bst.predict(np.asarray(data, dtype=np.float64),
                        num_iteration=num_iteration,
                        raw_score=predict_type == 1,
                        pred_leaf=predict_type == 2)
+
+
+def LGBM_MetricsScrape(fmt: str = "prometheus") -> str:
+    """Process-global metrics registry export: 'prometheus' textfile
+    format or 'json'.  Not part of the reference C API — the hook a
+    serving wrapper exposes on its /metrics endpoint."""
+    from .obs.metrics import REGISTRY
+    if fmt == "prometheus":
+        return REGISTRY.to_prometheus()
+    if fmt == "json":
+        return REGISTRY.to_json()
+    raise LightGBMError("LGBM_MetricsScrape: unknown format %r "
+                        "(expected prometheus/json)" % (fmt,))
 
 
 def LGBM_BoosterPredictForFile(handle: int, data_filename: str,

@@ -94,6 +94,7 @@ def run_train(cfg: Config) -> None:
         import jax
         jax.profiler.start_trace(str(profile_dir))
         Log.info("jax.profiler trace -> %s", profile_dir)
+    finished = False
     try:
         for it in range(cfg.num_iterations):
             t0 = time.time()
@@ -105,16 +106,19 @@ def run_train(cfg: Config) -> None:
                                            % (cfg.output_model, it + 1))
             if stop:
                 break
+        finished = True
     finally:
         if profile_dir:
             import jax
             jax.profiler.stop_trace()   # keep the trace on failures too
         # finalize run telemetry (lightgbm_tpu/obs): run_end + flush, so a
-        # failed run still leaves a readable timeline
-        booster._obs.close()
+        # failed run still leaves a readable timeline (status=aborted)
+        booster._obs.close(status="ok" if finished else "aborted")
     if cfg.obs_events_path:
         Log.info("Telemetry timeline -> %s (summarize with "
                  "tools/trace_summary.py)", cfg.obs_events_path)
+    if cfg.obs_metrics_path:
+        Log.info("Metrics export -> %s", cfg.obs_metrics_path)
     booster.save_model_to_file(cfg.output_model)
     Log.info("Finished training")
 

@@ -93,32 +93,38 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     booster.best_iteration = -1
     finished_iteration = num_boost_round
     evaluation_result_list = []  # stays empty when num_boost_round == 0
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=i,
-                                        begin_iteration=init_iteration,
-                                        end_iteration=init_iteration + num_boost_round,
-                                        evaluation_result_list=None))
-        booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets is not None or feval is not None:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
+    try:
+        for i in range(init_iteration, init_iteration + num_boost_round):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
                                             iteration=i,
                                             begin_iteration=init_iteration,
                                             end_iteration=init_iteration + num_boost_round,
-                                            evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as earlyStopException:
-            booster.best_iteration = earlyStopException.best_iteration + 1
-            evaluation_result_list = earlyStopException.best_score
-            finished_iteration = booster.best_iteration
-            break
+                                            evaluation_result_list=None))
+            booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if valid_sets is not None or feval is not None:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i,
+                                                begin_iteration=init_iteration,
+                                                end_iteration=init_iteration + num_boost_round,
+                                                evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as earlyStopException:
+                booster.best_iteration = earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                finished_iteration = booster.best_iteration
+                break
+    except BaseException:
+        # a crashed run still finalizes its timeline: run_end
+        # lands with status='aborted' and the writer flushes
+        booster.finalize_telemetry(status="aborted")
+        raise
     booster.best_score = collections.defaultdict(dict)
     for dataset_name, eval_name, score, _ in evaluation_result_list or []:
         booster.best_score[dataset_name][eval_name] = score
@@ -247,34 +253,38 @@ def cv(params, train_set, num_boost_round: int = 10, folds=None, nfold: int = 5,
                                                 early_stopping_rounds,
                                                 show_stdv)
 
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(model=cvbooster, params=params,
-                                        iteration=i, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=None))
-        agg = collections.defaultdict(list)
-        # broadcast through CVBooster.__getattr__, as the reference's cv
-        # drives its folds (engine.py:398-401)
-        cvbooster.update(fobj=fobj)
-        for fold_evals in cvbooster.eval_valid(feval):
-            for (_, name, score, hb) in fold_evals:
-                agg[(name, hb)].append(score)
-        res = []
-        for (name, hb), scores in agg.items():
-            mean, stdv = float(np.mean(scores)), float(np.std(scores))
-            results[name + "-mean"].append(mean)
-            results[name + "-stdv"].append(stdv)
-            res.append(("cv_agg", name, mean, hb, stdv))
-        try:
-            for cb in cbs_after:
+    try:
+        for i in range(num_boost_round):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(model=cvbooster, params=params,
                                             iteration=i, begin_iteration=0,
                                             end_iteration=num_boost_round,
-                                            evaluation_result_list=res))
-        except callback_mod.EarlyStopException as e:
-            for k in list(results.keys()):
-                results[k] = results[k][:e.best_iteration + 1]
-            break
+                                            evaluation_result_list=None))
+            agg = collections.defaultdict(list)
+            # broadcast through CVBooster.__getattr__, as the reference's cv
+            # drives its folds (engine.py:398-401)
+            cvbooster.update(fobj=fobj)
+            for fold_evals in cvbooster.eval_valid(feval):
+                for (_, name, score, hb) in fold_evals:
+                    agg[(name, hb)].append(score)
+            res = []
+            for (name, hb), scores in agg.items():
+                mean, stdv = float(np.mean(scores)), float(np.std(scores))
+                results[name + "-mean"].append(mean)
+                results[name + "-stdv"].append(stdv)
+                res.append(("cv_agg", name, mean, hb, stdv))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(model=cvbooster, params=params,
+                                                iteration=i, begin_iteration=0,
+                                                end_iteration=num_boost_round,
+                                                evaluation_result_list=res))
+            except callback_mod.EarlyStopException as e:
+                for k in list(results.keys()):
+                    results[k] = results[k][:e.best_iteration + 1]
+                break
+    except BaseException:
+        cvbooster.finalize_telemetry(status="aborted")
+        raise
     cvbooster.finalize_telemetry()     # broadcasts across folds
     return dict(results)

@@ -85,6 +85,7 @@ class GBDT:
         self._score_dev: Optional[jnp.ndarray] = None
         self._score_host: Optional[np.ndarray] = None
         self._obs = NULL_OBSERVER
+        self._metrics = None
         self.num_tree_per_iteration = 1
         if objective is not None:
             self.num_tree_per_iteration = objective.num_tree_per_iteration()
@@ -122,6 +123,7 @@ class GBDT:
         if prev.enabled:
             prev.close()
         self._obs = observer_from_config(config)
+        self._metrics = None
         if self._obs.enabled:
             devices = [{"id": int(d.id), "platform": str(d.platform),
                         "kind": str(getattr(d, "device_kind", ""))}
@@ -133,6 +135,24 @@ class GBDT:
             collective_info = getattr(self.learner, "collective_info", None)
             if collective_info is not None:
                 self._obs.event("collectives", **collective_info())
+            # registry instruments are only touched when the observer is
+            # on — the disabled hot path stays allocation-free (pinned by
+            # the overhead guard in tests/test_obs.py)
+            from ..obs import REGISTRY
+            self._metrics = {
+                "trees": REGISTRY.counter(
+                    "lgbm_trees_built_total",
+                    "trees grown on device by the training loop"),
+                "leaves": REGISTRY.counter(
+                    "lgbm_tree_leaves_built_total",
+                    "leaves across materialized trained trees"),
+            }
+            nbins = getattr(self.train_data, "num_bin_arr", None)
+            if nbins is not None:
+                REGISTRY.counter(
+                    "lgbm_dataset_bins_built_total",
+                    "feature-discretization bins constructed for "
+                    "training datasets").inc(int(np.sum(nbins)))
         self.learner.set_observer(self._obs)
 
     def reset_config(self, config: Config) -> None:
@@ -366,6 +386,10 @@ class GBDT:
             tree.shrink(self._models_shrink[i])
             self.models[i] = tree
             self._models_dev[i] = None
+        if self._metrics is not None:
+            # host num_leaves is free here — trees just landed on host
+            self._metrics["leaves"].inc(
+                sum(self.models[i].num_leaves for i in pending))
         # release device buffers
         self._models_shrink = [0.0 if m is not None else s
                                for m, s in zip(self.models, self._models_shrink)]
@@ -439,6 +463,14 @@ class GBDT:
         # "boost" = objective gradients + bagging (+ first-iter stub tree)
         obs.lap("boost", (g_dev, h_dev))
 
+        # health monitors (obs/health.py): dispatch the finiteness /
+        # magnitude reductions async now, verdicts in one sync below
+        health = obs.health
+        health_leaves = None
+        if health is not None and health.due(it0):
+            health.stage_gradients(g_dev, h_dev)
+            health_leaves = []
+
         num_leaves_this_iter = []
         for tid in range(k):
             if self.class_need_train[tid]:
@@ -476,6 +508,10 @@ class GBDT:
                 self._models_dev.append(dev_tree)
                 self._models_shrink.append(self.shrinkage_rate)
                 num_leaves_this_iter.append(dev_tree.num_leaves)
+                if health_leaves is not None:
+                    health_leaves.append(dev_tree.leaf_value)
+                if self._metrics is not None:
+                    self._metrics["trees"].inc()
             else:
                 tree = Tree(2)
                 if len(self.models) < k:
@@ -491,6 +527,12 @@ class GBDT:
                                 jnp.asarray(out, self.score_dtype))
                         self._invalidate_valid(vi)
                 self._append_host_tree(tree)
+
+        if health_leaves is not None:
+            # one batched device_get over the staged scalars; may raise
+            # LightGBMError under obs_health=fatal
+            health.stage_leaf_values(health_leaves)
+            health.run_checks(obs, it0)
 
         # stop check: any trained tree must have >1 leaves.  Evaluating the
         # device scalars here costs one sync; skip it when nothing forces a

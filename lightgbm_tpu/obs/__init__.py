@@ -1,4 +1,4 @@
-"""Run telemetry: structured event timeline, phase timers, profiler windows.
+"""Run telemetry: event timeline, metrics registry, health monitors.
 
 Every training run can self-instrument (the per-phase breakdowns that
 "GPU-acceleration for Large-scale Tree Boosting" and "XGBoost: Scalable
@@ -6,30 +6,41 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
 
 * ``events``  — versioned JSONL event emitter (run header with params /
   backend / device topology, per-iteration phase records, compile events,
-  memory snapshots) plus the ``RunObserver`` facade the training loop
-  drives and the allocation-free ``NULL_OBSERVER`` it holds by default;
+  memory snapshots, health verdicts, metrics snapshots) plus the
+  ``RunObserver`` facade the training loop drives and the
+  allocation-free ``NULL_OBSERVER`` it holds by default;
 * ``timers``  — phase clocks and per-entry-point timers that fence with
   ``jax.block_until_ready`` for device-accurate timings and split the
   first-call (compile) cost from steady-state execute cost;
 * ``memory``  — per-device ``memory_stats()`` snapshots at a cadence;
 * ``profile`` — programmatic ``jax.profiler.trace`` windows over exactly
-  the configured iterations (``obs_trace_iters=a:b`` + ``obs_trace_dir``).
+  the configured iterations (``obs_trace_iters=a:b`` + ``obs_trace_dir``);
+* ``metrics`` — process-global counters/gauges/histograms with
+  Prometheus-textfile and JSON export (``obs_metrics_path`` /
+  ``obs_metrics_every``);
+* ``health``  — non-finite guards, EMA loss divergence/plateau, memory
+  watermark (``obs_health=off/warn/fatal``).
 
 Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_memory_every``, ``obs_trace_iters``, ``obs_trace_dir``,
-``obs_flush_every``.  See docs/Observability.md for the schema.
+``obs_flush_every``, ``obs_health*``, ``obs_metrics*``.  See
+docs/Observability.md for the schema.
 """
 from __future__ import annotations
 
 from .events import (NULL_OBSERVER, SCHEMA_VERSION, EventWriter,
                      NullObserver, RunObserver, read_events, validate_event)
+from .health import HealthMonitors
+from .metrics import REGISTRY, MetricsRegistry
 from ..utils.log import Log
 
 __all__ = ["NULL_OBSERVER", "NullObserver", "RunObserver", "EventWriter",
            "SCHEMA_VERSION", "read_events", "validate_event",
-           "observer_from_config"]
+           "observer_from_config", "HealthMonitors", "MetricsRegistry",
+           "REGISTRY"]
 
 _TIMING_MODES = ("auto", "phase", "iter", "off")
+_HEALTH_MODES = ("off", "warn", "fatal")
 
 
 def observer_from_config(config):
@@ -42,11 +53,25 @@ def observer_from_config(config):
     iteration (accurate per-iteration totals, phases are dispatch-only —
     the bench protocol); 'off' records wall times without any fencing
     (dispatch cost only); 'auto' = 'phase'.
+
+    Any of ``obs_events_path`` / ``obs_trace_iters`` / ``obs_memory_every``
+    / ``obs_health`` (non-off) / ``obs_metrics_path`` /
+    ``obs_metrics_every`` enables the observer; health and metrics work
+    without an events path (in-memory timeline via Booster.telemetry()).
     """
     events_path = str(getattr(config, "obs_events_path", "") or "")
     trace_iters = str(getattr(config, "obs_trace_iters", "") or "")
     memory_every = int(getattr(config, "obs_memory_every", 0) or 0)
-    if not events_path and not trace_iters and memory_every <= 0:
+    health_mode = str(getattr(config, "obs_health", "off")
+                      or "off").strip().lower()
+    if health_mode not in _HEALTH_MODES:
+        Log.fatal("Unknown obs_health %s (expected off/warn/fatal)",
+                  health_mode)
+    metrics_path = str(getattr(config, "obs_metrics_path", "") or "")
+    metrics_every = int(getattr(config, "obs_metrics_every", 0) or 0)
+    if (not events_path and not trace_iters and memory_every <= 0
+            and health_mode == "off" and not metrics_path
+            and metrics_every <= 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -58,8 +83,20 @@ def observer_from_config(config):
     if trace_iters and not trace_dir:
         Log.fatal("obs_trace_iters requires obs_trace_dir (where the "
                   "jax.profiler trace is written)")
+    health = None
+    if health_mode != "off":
+        health = HealthMonitors(
+            mode=health_mode,
+            every=int(getattr(config, "obs_health_every", 1) or 1),
+            divergence=float(getattr(config, "obs_health_divergence",
+                                     3.0) or 0.0),
+            plateau=int(getattr(config, "obs_health_plateau", 0) or 0),
+            mem_frac=float(getattr(config, "obs_health_mem_frac",
+                                   0.9) or 0.0))
     return RunObserver(events_path=events_path, timing=timing,
                        memory_every=memory_every, trace_iters=trace_iters,
                        trace_dir=trace_dir,
                        flush_every=int(getattr(config, "obs_flush_every",
-                                               16) or 16))
+                                               16) or 16),
+                       health=health, metrics_every=metrics_every,
+                       metrics_path=metrics_path)

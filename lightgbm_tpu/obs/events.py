@@ -14,16 +14,25 @@ compile        entry, first_call_s, fenced
 memory         it, devices
 trace_window   action, dir, it
 collectives    learner (plus learner-specific topology/byte estimates)
-run_end        iters, phase_totals, entries
+health         check, status, it (schema 2; obs/health.py monitors)
+metrics        it, scrape (schema 2; obs/metrics.py registry snapshot)
+run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
 ``RunObserver`` is the facade the training loop drives; ``NULL_OBSERVER``
 is the shared disabled instance — every method is a no-op and the hot
 path pays one attribute check and an empty call, with no fencing and no
 event objects allocated.
+
+Crash safety: the writer flushes every ``flush_every`` events, the
+observer registers an ``atexit`` finalizer, and both are context
+managers — a run killed mid-iteration still ends with a parseable
+timeline whose last record is ``run_end`` with ``status="aborted"``
+whenever the interpreter gets to unwind.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import time
@@ -33,7 +42,9 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+# schema 1 timelines (no health/metrics events) still parse
+_ACCEPTED_SCHEMAS = (1, 2)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -44,6 +55,8 @@ _REQUIRED = {
     "memory": ("it", "devices"),
     "trace_window": ("action", "dir", "it"),
     "collectives": ("learner",),
+    "health": ("check", "status", "it"),
+    "metrics": ("it", "scrape"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -61,7 +74,7 @@ def validate_event(rec):
     missing = [k for k in _REQUIRED[ev] if k not in rec]
     if missing:
         raise ValueError("event %r missing keys %s" % (ev, missing))
-    if ev == "run_header" and rec["schema"] != SCHEMA_VERSION:
+    if ev == "run_header" and rec["schema"] not in _ACCEPTED_SCHEMAS:
         raise ValueError("unsupported schema version %r" % (rec["schema"],))
     return rec
 
@@ -103,12 +116,24 @@ class EventWriter:
             self._f.flush()
             self._pending = 0
 
+    def flush(self):
+        if self._f is not None:
+            self._f.flush()
+            self._pending = 0
+
     def close(self):
         if self._f is not None:
             self._f.flush()
             self._f.close()
             self._f = None
             self._pending = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
 
 
 class NullObserver:
@@ -118,6 +143,7 @@ class NullObserver:
 
     enabled = False
     timeline = ()
+    health = None
 
     def event(self, ev, **fields):
         pass
@@ -143,8 +169,15 @@ class NullObserver:
     def flush(self):
         pass
 
-    def close(self):
+    def close(self, status="ok"):
         pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close(status="aborted" if exc_type is not None else "ok")
+        return False
 
 
 NULL_OBSERVER = NullObserver()
@@ -159,7 +192,9 @@ class RunObserver(NullObserver):
     enabled = True
 
     def __init__(self, events_path="", timing="phase", memory_every=0,
-                 trace_iters="", trace_dir="", flush_every=16):
+                 trace_iters="", trace_dir="", flush_every=16,
+                 health=None, metrics_every=0, metrics_path=""):
+        from . import metrics as metrics_mod
         self.run_id = os.urandom(4).hex()
         self.timing = timing
         self.timeline = []
@@ -171,6 +206,18 @@ class RunObserver(NullObserver):
         self._trace = TraceWindow(trace_iters, trace_dir)
         self._iters = 0
         self._closed = False
+        self.health = health                 # HealthMonitors or None
+        self._metrics_every = max(0, int(metrics_every))
+        self._metrics_path = str(metrics_path or "")
+        self._registry = metrics_mod.REGISTRY
+        self._m_iter_s = self._registry.histogram(
+            "lgbm_train_iter_seconds",
+            "per-iteration wall time as timed by the run observer "
+            "(fencing per obs_timing)")
+        self._m_iters = self._registry.counter(
+            "lgbm_train_iterations_total", "boosting iterations completed")
+        # a killed run must still end in a flushed, parseable timeline
+        atexit.register(self._finalize_at_exit)
 
     # -- raw emission --------------------------------------------------
     def event(self, ev, **fields):
@@ -199,11 +246,26 @@ class RunObserver(NullObserver):
             fence(value)
         total, phases = self._clock.end()
         self._iters += 1
+        self._m_iter_s.observe(total)
+        self._m_iters.inc()
         self.event("iter", it=it, time_s=total, phases=phases,
                    fenced=(self.timing in ("phase", "iter")), **fields)
         devices = self._memory.maybe(it)
         if devices is not None:
             self.event("memory", it=it, devices=devices)
+            for d in devices:
+                if "bytes_in_use" in d:
+                    self._registry.gauge(
+                        "lgbm_device_bytes_in_use",
+                        "device allocator bytes in use at the last snapshot",
+                        labels={"device": str(d["id"])}).set(
+                            d["bytes_in_use"])
+        if self.health is not None and self.health.due(it):
+            # may raise under obs_health=fatal — the iter event above and
+            # the writer flush in the monitor keep the timeline parseable
+            self.health.check_memory(self, it, devices)
+        if self._metrics_every and it % self._metrics_every == 0:
+            self.event("metrics", it=it, scrape=self._registry.snapshot())
         self._trace.maybe_stop(it, self)
 
     # -- jitted entry points ------------------------------------------
@@ -223,20 +285,50 @@ class RunObserver(NullObserver):
         self.event("memory", it=it, devices=device_memory_stats())
 
     def flush(self):
-        if self._writer is not None and self._writer._f is not None:
-            self._writer._f.flush()
-            self._writer._pending = 0
+        if self._writer is not None:
+            self._writer.flush()
 
-    def close(self):
+    def close(self, status="ok"):
         if self._closed:
             return
         self._closed = True
+        try:
+            atexit.unregister(self._finalize_at_exit)
+        except Exception:
+            pass
         self._trace.force_stop(self)
-        self.event("run_end", iters=self._iters,
-                   phase_totals=self._clock.totals(),
-                   entries=self._entries.summary())
+        metrics_on = self._metrics_every or self._metrics_path
+        if metrics_on:
+            self.event("metrics", it=self._iters,
+                       scrape=self._registry.snapshot())
+        end = {"iters": self._iters, "phase_totals": self._clock.totals(),
+               "entries": self._entries.summary(), "status": status}
+        if self.health is not None:
+            end["health"] = self.health.summary()
+        self.event("run_end", **end)
+        if self._metrics_path:
+            try:
+                self._registry.write(self._metrics_path)
+                Log.debug("obs: metrics export -> %s", self._metrics_path)
+            except OSError as e:
+                Log.warning("obs: metrics export to %s failed: %s",
+                            self._metrics_path, e)
         if self._writer is not None:
             self._writer.close()
-        if self._writer is not None:
             Log.debug("obs: wrote %d events to %s", len(self.timeline),
                       self._writer.path)
+
+    def _finalize_at_exit(self):
+        """atexit hook: a run that never reached finalize (crash, sys.exit,
+        uncaught signal that still unwinds) ends aborted but parseable."""
+        try:
+            self.close(status="aborted")
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close(status="aborted" if exc_type is not None else "ok")
+        return False

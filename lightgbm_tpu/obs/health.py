@@ -1,0 +1,214 @@
+"""Training health monitors: catch sick runs while they are cheap.
+
+A diverging or NaN-poisoned boosting run on a TPU pod burns its whole
+reservation before anyone reads the metrics; the monitors here watch the
+quantities the training loop already has in flight and emit ``health``
+events into the run timeline (events.py) the moment something is off:
+
+* **non-finite guard** — gradients, hessians and grown-tree leaf values.
+  One ``mean(abs(x))`` reduction per array: the mean is NaN/Inf iff any
+  element is non-finite, so a single device scalar answers both "is it
+  finite" and "how big is it".  All reductions are dispatched async and
+  pulled in one batched ``device_get`` — one extra sync per checked
+  iteration, nothing per-element on the host.
+* **EMA divergence / plateau** — an exponential moving average over the
+  per-iteration gradient magnitude (the training signal that exists every
+  iteration, eval or not).  Divergence fires after two consecutive checks
+  above ``divergence x EMA``; plateau fires after ``plateau`` consecutive
+  checks with relative EMA movement under 1e-4 (plateau is informational
+  and never escalates to fatal).
+* **memory watermark** — per-device ``bytes_in_use`` against
+  ``bytes_limit`` where the backend reports them (TPU/GPU; the CPU
+  backend has no byte counters and the check is a no-op), with the peak
+  fraction tracked per device.
+
+``obs_health`` picks the consequence: ``off`` (no monitors), ``warn``
+(log + ``health`` event), ``fatal`` (log + event + flush the timeline +
+raise LightGBMError, aborting the run).  Cadence via ``obs_health_every``.
+"""
+from __future__ import annotations
+
+import math
+
+from ..utils.log import Log
+
+MODES = ("off", "warn", "fatal")
+
+# checks that never abort the run even under obs_health=fatal: a flat
+# loss is a tuning smell, not a poisoned run
+_WARN_ONLY = frozenset(("plateau",))
+
+_PLATEAU_REL = 1e-4
+
+
+def _finite(x):
+    return math.isfinite(x)
+
+
+class HealthMonitors:
+    """Stateful per-run monitor set.  The training loop stages device
+    reductions while the iteration is in flight (``stage_gradients``,
+    ``stage_leaf_values``) and the observer triggers the single host
+    sync + verdicts at iteration end (``run_checks``)."""
+
+    def __init__(self, mode="warn", every=1, divergence=3.0, plateau=0,
+                 mem_frac=0.9, ema_alpha=0.3):
+        if mode not in MODES:
+            raise ValueError("obs_health mode %r (expected off/warn/fatal)"
+                             % (mode,))
+        self.mode = mode
+        self.every = max(1, int(every))
+        self.divergence = float(divergence)
+        self.plateau = int(plateau)
+        self.mem_frac = float(mem_frac)
+        self.ema_alpha = float(ema_alpha)
+        self._staged = None            # (mean|g|, mean|h|) device scalars
+        self._staged_leaves = None     # max|leaf_value| device scalar
+        self._ema = None
+        self._diverging = 0
+        self._flat = 0
+        self.mem_peak_frac = {}        # device id -> peak in_use/limit
+        self.counts = {"ok": 0, "warn": 0, "fatal": 0}
+
+    # ----------------------------------------------------------- staging
+    def due(self, it):
+        return it % self.every == 0
+
+    def stage_gradients(self, g_dev, h_dev):
+        """Dispatch the finiteness/magnitude reductions without syncing;
+        the results are pulled in run_checks."""
+        import jax.numpy as jnp
+        self._staged = (jnp.mean(jnp.abs(g_dev)), jnp.mean(jnp.abs(h_dev)))
+
+    def stage_leaf_values(self, leaf_values):
+        """``leaf_values``: device arrays of the leaf outputs grown this
+        iteration (one per tree)."""
+        import jax.numpy as jnp
+        if leaf_values:
+            self._staged_leaves = jnp.max(jnp.stack(
+                [jnp.max(jnp.abs(lv)) for lv in leaf_values]))
+
+    # ----------------------------------------------------------- verdicts
+    def run_checks(self, obs, it):
+        """One batched host sync over the staged scalars, then verdicts.
+        Emits a ``health`` stats event plus one event per firing check;
+        raises LightGBMError under mode='fatal'."""
+        import jax
+        staged = list(self._staged or ())
+        has_leaves = self._staged_leaves is not None
+        if has_leaves:
+            staged.append(self._staged_leaves)
+        self._staged = None
+        self._staged_leaves = None
+        if not staged:
+            return
+        host = [float(x) for x in jax.device_get(staged)]
+        stats = {}
+        problems = []
+        g_mean = h_mean = None
+        if len(host) >= 2 + (1 if has_leaves else 0):
+            g_mean, h_mean = host[0], host[1]
+            stats["grad_abs_mean"] = g_mean
+            stats["hess_abs_mean"] = h_mean
+            if not _finite(g_mean) or not _finite(h_mean):
+                problems.append(("nonfinite_gradients",
+                                 {"grad_abs_mean": repr(g_mean),
+                                  "hess_abs_mean": repr(h_mean)}))
+        if has_leaves:
+            leaf_max = host[-1]
+            stats["leaf_abs_max"] = leaf_max
+            if not _finite(leaf_max):
+                problems.append(("nonfinite_leaf_values",
+                                 {"leaf_abs_max": repr(leaf_max)}))
+        problems.extend(self._trend(g_mean))
+        status = "ok" if not problems else self.mode
+        obs.event("health", check="stats", status=status, it=it,
+                  detail=stats)
+        self.counts["ok" if not problems else self.mode] += 1
+        self._resolve(obs, it, problems)
+
+    def _trend(self, g_mean):
+        """EMA divergence / plateau over the gradient-magnitude series."""
+        out = []
+        if g_mean is None or not _finite(g_mean):
+            return out
+        if self._ema is None:
+            self._ema = g_mean
+            return out
+        prev = self._ema
+        if self.divergence > 0 and g_mean > self.divergence * prev + 1e-300:
+            self._diverging += 1
+            if self._diverging >= 2:
+                out.append(("loss_divergence",
+                            {"grad_abs_mean": g_mean, "ema": prev,
+                             "factor": self.divergence,
+                             "consecutive": self._diverging}))
+        else:
+            self._diverging = 0
+        self._ema = (1.0 - self.ema_alpha) * prev + self.ema_alpha * g_mean
+        rel = abs(self._ema - prev) / max(abs(prev), 1e-300)
+        if self.plateau > 0:
+            if rel < _PLATEAU_REL:
+                self._flat += 1
+                if self._flat >= self.plateau:
+                    out.append(("plateau",
+                                {"ema": self._ema, "rel_change": rel,
+                                 "checks": self._flat}))
+                    self._flat = 0
+            else:
+                self._flat = 0
+        return out
+
+    def check_memory(self, obs, it, devices=None):
+        """Per-device in-use/limit watermark; ``devices`` reuses an
+        already-captured memory snapshot when the cadences line up."""
+        if self.mem_frac <= 0:
+            return
+        if devices is None:
+            from .memory import device_memory_stats
+            devices = device_memory_stats()
+        problems = []
+        for d in devices:
+            limit = d.get("bytes_limit", 0)
+            in_use = d.get("bytes_in_use")
+            if not limit or in_use is None:
+                continue          # CPU backend: identity rows only
+            frac = in_use / limit
+            did = d["id"]
+            if frac > self.mem_peak_frac.get(did, 0.0):
+                self.mem_peak_frac[did] = frac
+            if frac > self.mem_frac:
+                problems.append(("memory_watermark",
+                                 {"device": did, "bytes_in_use": in_use,
+                                  "bytes_limit": limit,
+                                  "frac": round(frac, 4),
+                                  "threshold": self.mem_frac}))
+        if problems:
+            self.counts[self.mode] += 1
+        self._resolve(obs, it, problems)
+
+    # ------------------------------------------------------------ actions
+    def _resolve(self, obs, it, problems):
+        fatal = []
+        for check, detail in problems:
+            status = ("warn" if (self.mode == "warn"
+                                 or check in _WARN_ONLY) else "fatal")
+            obs.event("health", check=check, status=status, it=it,
+                      detail=detail)
+            Log.warning("health[%s] %s at iteration %d: %s",
+                        status, check, it, detail)
+            if status == "fatal":
+                fatal.append(check)
+        if fatal:
+            obs.flush()           # the timeline must survive the raise
+            Log.fatal("obs_health=fatal: %s tripped at iteration %d "
+                      "(timeline has the health event)"
+                      % ("/".join(fatal), it))
+
+    def summary(self):
+        """Folded into run_end: verdict counts + per-device memory peaks."""
+        out = {"mode": self.mode, "counts": dict(self.counts)}
+        if self.mem_peak_frac:
+            out["mem_peak_frac"] = {str(k): round(v, 4)
+                                    for k, v in self.mem_peak_frac.items()}
+        return out

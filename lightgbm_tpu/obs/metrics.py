@@ -1,0 +1,289 @@
+"""Low-overhead metrics registry: counters, gauges, fixed-bucket histograms.
+
+The timeline (events.py) answers "what happened inside THIS run"; the
+registry answers "how much work has this process done" — the aggregate
+counters a serving deployment scrapes (the per-kernel counter discipline
+of "XGBoost: Scalable GPU Accelerated Learning").  Instruments are
+process-global (``REGISTRY``), cheap enough for the serving path (one
+lock + an int add per observation), and export two ways:
+
+* Prometheus textfile exposition format (``to_prometheus`` /
+  ``REGISTRY.write("metrics.prom")``) for node-exporter style scraping;
+* a JSON snapshot (``snapshot`` / ``to_json``) — the same dict the run
+  observer embeds in ``metrics`` timeline events.
+
+Training-path instruments are only touched when the run observer is
+enabled (the disabled hot path stays allocation-free, pinned by the
+overhead-guard test in tests/test_obs.py); the predict/serving path
+records unconditionally.
+
+Histogram semantics follow Prometheus: cumulative buckets keyed by
+upper bound ``le`` (inclusive), plus ``_sum``/``_count``.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+
+# default latency buckets (seconds) — the standard Prometheus ladder
+# stretched to cover XLA compiles
+TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+# batch-size buckets (rows per predict call)
+SIZE_BUCKETS = (1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6, 1e7)
+
+
+def _fmt(v):
+    """Prometheus sample formatting: integers without the trailing .0."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _series(name, labels):
+    if not labels:
+        return name
+    inner = ",".join('%s="%s"' % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, inner)
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(self, name, help="", labels=None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counter %s: negative increment %r"
+                             % (self.name, amount))
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def _export(self):
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """A value that can go up and down (watermarks, in-flight counts)."""
+
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(self, name, help="", labels=None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        self.inc(-amount)
+
+    def max(self, value):
+        """Watermark update: keep the larger of current and ``value``."""
+        with self._lock:
+            if value > self._value:
+                self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+    def _export(self):
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Fixed-bucket histogram, Prometheus ``le`` (inclusive upper bound)
+    semantics with an implicit +Inf bucket."""
+
+    __slots__ = ("name", "help", "labels", "buckets", "_counts", "_sum",
+                 "_count", "_lock")
+
+    def __init__(self, name, help="", labels=None, buckets=TIME_BUCKETS):
+        b = tuple(float(x) for x in buckets)
+        if not b or list(b) != sorted(set(b)):
+            raise ValueError("histogram %s: buckets must be strictly "
+                             "increasing, got %r" % (name, buckets))
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self.buckets = b
+        self._counts = [0] * (len(b) + 1)      # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def cumulative(self):
+        """[(le_str, cumulative_count), ...] ending with '+Inf'."""
+        out = []
+        acc = 0
+        for le, c in zip(self.buckets, self._counts):
+            acc += c
+            out.append((_fmt(le), acc))
+        out.append(("+Inf", acc + self._counts[-1]))
+        return out
+
+    def _export(self):
+        return {"type": "histogram", "count": self._count,
+                "sum": self._sum,
+                "buckets": {le: c for le, c in self.cumulative()}}
+
+
+class MetricsRegistry:
+    """Named instruments, get-or-create.  One series per (name, labels);
+    re-requesting an existing series returns the same instrument, and a
+    type mismatch raises rather than silently forking the series."""
+
+    _TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._series = {}          # (name, labels-key) -> instrument
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            inst = self._series.get(key)
+            if inst is None:
+                inst = cls(name, help=help, labels=labels, **kw)
+                self._series[key] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    "metric %r already registered as %s, requested %s"
+                    % (name, type(inst).__name__, cls.__name__))
+            return inst
+
+    def counter(self, name, help="", labels=None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=None,
+                  buckets=TIME_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def reset(self):
+        """Drop every instrument (tests; a fresh process-global slate)."""
+        with self._lock:
+            self._series.clear()
+
+    # ------------------------------------------------------------ export
+    def snapshot(self):
+        """{series: export-dict} — counters/gauges carry ``value``,
+        histograms ``count``/``sum``/cumulative ``buckets``.  This is the
+        payload of ``metrics`` timeline events."""
+        with self._lock:
+            series = list(self._series.values())
+        return {_series(m.name, m.labels): m._export() for m in series}
+
+    def to_json(self, indent=None):
+        return json.dumps({"metrics": self.snapshot()}, indent=indent,
+                          sort_keys=True)
+
+    def to_prometheus(self):
+        """Prometheus textfile exposition format (one HELP/TYPE block per
+        metric family, series within a family grouped together)."""
+        with self._lock:
+            series = list(self._series.values())
+        families = {}
+        for m in series:
+            families.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(families):
+            fam = families[name]
+            kind = {Counter: "counter", Gauge: "gauge",
+                    Histogram: "histogram"}[type(fam[0])]
+            help_text = next((m.help for m in fam if m.help), "")
+            if help_text:
+                lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            for m in fam:
+                if isinstance(m, Histogram):
+                    for le, c in m.cumulative():
+                        lbl = dict(m.labels)
+                        lbl["le"] = le
+                        lines.append("%s %s"
+                                     % (_series(name + "_bucket", lbl),
+                                        _fmt(c)))
+                    lines.append("%s %s" % (_series(name + "_sum", m.labels),
+                                            _fmt(m._sum)))
+                    lines.append("%s %s" % (_series(name + "_count",
+                                                    m.labels),
+                                            _fmt(m._count)))
+                else:
+                    lines.append("%s %s" % (_series(name, m.labels),
+                                            _fmt(m.value)))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write(self, path):
+        """Export to ``path``: Prometheus textfile format for ``.prom`` /
+        ``.txt`` suffixes, JSON otherwise."""
+        path = str(path)
+        if path.endswith((".prom", ".txt")):
+            body = self.to_prometheus()
+        else:
+            body = self.to_json(indent=2) + "\n"
+        import os
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(body)
+        return path
+
+
+# the process-global registry every subsystem records into
+REGISTRY = MetricsRegistry()
+
+
+def observe_predict(rows, seconds):
+    """Serving-path instrumentation: one call per predict request.
+    Unconditional (no observer gate) — three lock/adds per request is
+    noise next to a traversal, and the serving path has no training-run
+    observer to gate on."""
+    REGISTRY.histogram(
+        "lgbm_predict_seconds",
+        "per-request predict latency (seconds)").observe(seconds)
+    REGISTRY.histogram(
+        "lgbm_predict_batch_rows",
+        "rows per predict request", buckets=SIZE_BUCKETS).observe(rows)
+    REGISTRY.counter(
+        "lgbm_predict_rows_total", "total rows predicted").inc(int(rows))

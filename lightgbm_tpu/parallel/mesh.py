@@ -100,6 +100,41 @@ def make_row_sharded(mesh: Mesh, host_local: np.ndarray, extra_dims=0):
     return jax.make_array_from_process_local_data(sharding, host_local)
 
 
+def _per_tree_collective_bytes(learner) -> int:
+    """Per-tree collective traffic from collective_info()'s per-reduce
+    estimates x the number of reduces a tree issues (splits, or wave
+    sweeps) — the increment train_device adds to the registry counter."""
+    info = learner.collective_info()
+    splits = max(int(learner.num_leaves) - 1, 1)
+    total = 0
+    for coll in ("psum", "allgather"):
+        d = info.get(coll) or {}
+        if "per_wave_bytes" in d:
+            w = max(int(getattr(learner, "wave_width", 1) or 1), 1)
+            total += d["per_wave_bytes"] * ((splits + w - 1) // w)
+        elif "per_leaf_bytes" in d:
+            total += d["per_leaf_bytes"] * splits
+        elif "per_split_bytes" in d:
+            total += d["per_split_bytes"] * splits
+    return int(total)
+
+
+def _init_collective_counter(learner, obs) -> None:
+    """set_observer for distributed learners: the base contract
+    (learner._obs = obs) plus the collective-bytes counter
+    (obs/metrics.py), accumulated per grown tree — created only when the
+    observer is on so the disabled hot path stays allocation-free."""
+    learner._obs = obs
+    learner._m_coll = None
+    if getattr(obs, "enabled", False):
+        from ..obs import REGISTRY
+        learner._m_coll = REGISTRY.counter(
+            "lgbm_collective_bytes_total",
+            "estimated bytes moved by cross-device collectives "
+            "(psum/all_gather) during tree growth")
+        learner._coll_tree_bytes = _per_tree_collective_bytes(learner)
+
+
 class DataParallelTreeLearner(SerialTreeLearner):
     """Row-sharded learner; one psum per histogram construction.
 
@@ -289,6 +324,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
                                 f * self.num_bins * 3 * dtype_bytes}
         return info
 
+    def set_observer(self, obs) -> None:
+        _init_collective_counter(self, obs)
+
     def _dummy_tree_spec(self):
         # a TreeArrays-shaped pytree of None leaves for out_specs mapping
         from ..ops.grow import TreeArrays
@@ -333,6 +371,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
         t0 = obs.entry_start()
         tree, leaf_id = self._grow(*args)
         obs.entry_end("tree_grow", t0, (tree, leaf_id))
+        if getattr(self, "_m_coll", None) is not None:
+            self._m_coll.inc(self._coll_tree_bytes)
         if self._nproc > 1:
             return tree, leaf_id     # global, matches global score arrays
         return tree, leaf_id[:self.train_data.num_data] if self._pad else leaf_id
@@ -431,6 +471,15 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         if self._fpad:
             mask = jnp.concatenate([mask, jnp.zeros(self._fpad, bool)])
         return mask
+
+    def set_observer(self, obs) -> None:
+        _init_collective_counter(self, obs)
+
+    def train_device(self, grad, hess, row_mult=None, feature_mask=None):
+        out = super().train_device(grad, hess, row_mult, feature_mask)
+        if getattr(self, "_m_coll", None) is not None:
+            self._m_coll.inc(self._coll_tree_bytes)
+        return out
 
     def collective_info(self):
         """Per-split traffic: one packed-SplitInfo all_gather (the

@@ -94,6 +94,15 @@ class Predictor:
                 "none", early_stop_freq, early_stop_margin)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
+        import time
+        from .obs.metrics import observe_predict
+        t0 = time.perf_counter()
+        out = self._predict_impl(features)
+        observe_predict(np.asarray(out).shape[0] if np.ndim(out) else 1,
+                        time.perf_counter() - t0)
+        return out
+
+    def _predict_impl(self, features: np.ndarray) -> np.ndarray:
         features = np.ascontiguousarray(np.asarray(features, dtype=np.float64))
         if features.ndim == 1:
             features = features.reshape(1, -1)

@@ -98,6 +98,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_profile_iters": "obs_trace_iters",
     "obs_profile_dir": "obs_trace_dir",
     "obs_memory_freq": "obs_memory_every",
+    "obs_health_mode": "obs_health",
+    "obs_health_freq": "obs_health_every",
+    "obs_metrics_file": "obs_metrics_path",
+    "obs_metrics": "obs_metrics_path",
+    "obs_metrics_freq": "obs_metrics_every",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -143,6 +148,9 @@ PARAMETER_SET = {
     # observability (lightgbm_tpu/obs/)
     "obs_events_path", "obs_timing", "obs_memory_every",
     "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
+    "obs_health", "obs_health_every", "obs_health_divergence",
+    "obs_health_plateau", "obs_health_mem_frac",
+    "obs_metrics_path", "obs_metrics_every",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -459,6 +467,30 @@ class Config:
         "obs_trace_dir": ("str", ""),
         # flush the JSONL writer every N events (crash-tolerant timeline)
         "obs_flush_every": ("int", 16),
+        # training health monitors (lightgbm_tpu/obs/health.py):
+        # 'off' | 'warn' | 'fatal'.  warn logs + emits a `health` event;
+        # fatal additionally flushes the timeline and raises
+        # LightGBMError, aborting the run.  Non-default turns the
+        # observer on even without obs_events_path (in-memory timeline).
+        "obs_health": ("str", "off"),
+        # run the health checks every N iterations
+        "obs_health_every": ("int", 1),
+        # loss-divergence trigger: gradient magnitude above
+        # divergence x EMA for 2 consecutive checks (<=0 disables)
+        "obs_health_divergence": ("float", 3.0),
+        # plateau trigger after N consecutive near-flat checks
+        # (0 = off; plateau warns but never escalates to fatal)
+        "obs_health_plateau": ("int", 0),
+        # memory watermark: warn/fatal when any device's bytes_in_use
+        # exceeds this fraction of bytes_limit (backends with byte
+        # counters only; <=0 disables)
+        "obs_health_mem_frac": ("float", 0.9),
+        # write the metrics-registry export at run end: Prometheus
+        # textfile format for .prom/.txt suffixes, JSON otherwise
+        "obs_metrics_path": ("str", ""),
+        # embed a registry snapshot (`metrics` event) in the timeline
+        # every N iterations (0 = only the final snapshot at run end)
+        "obs_metrics_every": ("int", 0),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -1,0 +1,470 @@
+"""Metrics registry, health monitors, and bench_compare gating (PR 2).
+
+Covers the layers on top of the PR-1 run timeline:
+  * registry semantics — counter/gauge/histogram, le-inclusive buckets,
+    get-or-create identity, type-mismatch errors;
+  * Prometheus textfile + JSON export golden output and file routing;
+  * health monitors — non-finite gradients injected through a custom
+    fobj under obs_health=warn (run completes, warn events in the
+    timeline) and obs_health=fatal (run aborts, fatal event + run_end
+    status=aborted in the JSONL); EMA divergence, plateau (warn-only),
+    memory watermark at the unit level;
+  * EventWriter / RunObserver crash-safety (context managers, atexit
+    finalization path);
+  * tools/bench_compare.py exit codes on synthetic baselines.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import (NULL_OBSERVER, EventWriter, HealthMonitors,
+                              MetricsRegistry, REGISTRY, RunObserver,
+                              observer_from_config, read_events)
+from lightgbm_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                      observe_predict)
+from lightgbm_tpu.utils.config import Config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _data(n=400, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(params, n_rounds=5, fobj=None):
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    base.update(params)
+    if fobj is not None:
+        base.pop("objective", None)
+    return lgb.train(base, lgb.Dataset(X, label=y),
+                     num_boost_round=n_rounds, fobj=fobj,
+                     verbose_eval=False)
+
+
+class _CollectObs:
+    """Minimal observer double for unit-level health tests."""
+
+    def __init__(self):
+        self.events = []
+        self.flushed = 0
+
+    def event(self, ev, **fields):
+        self.events.append(dict(fields, ev=ev))
+
+    def flush(self):
+        self.flushed += 1
+
+
+# --------------------------------------------------------------- registry
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs processed")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create: same (name, labels) -> same instrument
+    assert reg.counter("jobs_total") is c
+    # distinct labels -> distinct series
+    c2 = reg.counter("jobs_total", labels={"kind": "a"})
+    assert c2 is not c and c2.value == 0
+    # type mismatch on an existing name raises, never forks the series
+    with pytest.raises(ValueError):
+        reg.gauge("jobs_total")
+
+
+def test_gauge_semantics():
+    g = Gauge("temp")
+    g.set(5.0)
+    g.inc(2)
+    g.dec()
+    assert g.value == 6.0
+    g.max(4.0)            # watermark keeps the larger value
+    assert g.value == 6.0
+    g.max(9.0)
+    assert g.value == 9.0
+
+
+def test_histogram_buckets_le_inclusive():
+    h = Histogram("lat", buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)        # exactly on the bound -> counts in le="0.5"
+    h.observe(2.0)        # beyond the last bound -> +Inf only
+    assert h.cumulative() == [("0.5", 2), ("1", 2), ("+Inf", 3)]
+    assert h.count == 3 and h.sum == pytest.approx(2.75)
+    exp = h._export()
+    assert exp["type"] == "histogram"
+    assert exp["buckets"] == {"0.5": 2, "1": 2, "+Inf": 3}
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(1.0, 1.0))      # not strictly increasing
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(2.0, 1.0))
+
+
+def test_prometheus_export_golden():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs processed").inc(3)
+    reg.gauge("temp", labels={"room": "a"}).set(2.5)
+    h = reg.histogram("lat", "request latency", buckets=(0.5, 1.0))
+    for v in (0.25, 0.5, 2.0):
+        h.observe(v)
+    assert reg.to_prometheus() == (
+        "# HELP jobs_total jobs processed\n"
+        "# TYPE jobs_total counter\n"
+        "jobs_total 3\n"
+        "# HELP lat request latency\n"
+        "# TYPE lat histogram\n"
+        'lat_bucket{le="0.5"} 2\n'
+        'lat_bucket{le="1"} 2\n'
+        'lat_bucket{le="+Inf"} 3\n'
+        "lat_sum 2.75\n"
+        "lat_count 3\n"
+        "# TYPE temp gauge\n"
+        'temp{room="a"} 2.5\n')
+
+
+def test_json_export_and_write_routing(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("jobs_total").inc(7)
+    doc = json.loads(reg.to_json())
+    assert doc == {"metrics": {"jobs_total": {"type": "counter",
+                                              "value": 7}}}
+    prom = tmp_path / "m.prom"
+    reg.write(prom)
+    assert "# TYPE jobs_total counter" in prom.read_text()
+    js = tmp_path / "m.json"
+    reg.write(js)
+    assert json.loads(js.read_text())["metrics"]["jobs_total"]["value"] == 7
+
+
+def test_observe_predict_records_into_global_registry():
+    before = REGISTRY.counter("lgbm_predict_rows_total").value
+    observe_predict(25, 0.01)
+    reg_snap = REGISTRY.snapshot()
+    assert REGISTRY.counter("lgbm_predict_rows_total").value == before + 25
+    assert reg_snap["lgbm_predict_seconds"]["count"] >= 1
+    assert reg_snap["lgbm_predict_batch_rows"]["count"] >= 1
+
+
+def test_predict_path_is_instrumented():
+    bst = _train({})
+    X, _ = _data()
+    before = REGISTRY.counter("lgbm_predict_rows_total").value
+    bst.predict(X[:50])
+    assert REGISTRY.counter("lgbm_predict_rows_total").value >= before + 50
+
+
+# ------------------------------------------------- training integration
+
+def test_training_emits_metric_snapshots_and_export(tmp_path):
+    events = tmp_path / "ev.jsonl"
+    prom = tmp_path / "metrics.prom"
+    before_iters = REGISTRY.counter("lgbm_train_iterations_total").value
+    before_trees = REGISTRY.counter("lgbm_trees_built_total").value
+    _train({"obs_events_path": str(events), "obs_metrics_every": 2,
+            "obs_metrics_path": str(prom), "obs_health": "warn"},
+           n_rounds=5)
+    evs = read_events(str(events))
+    kinds = [e["ev"] for e in evs]
+    assert kinds[-1] == "run_end"
+    end = evs[-1]
+    assert end["status"] == "ok"
+    assert end["health"]["mode"] == "warn"
+    # clean data: every health verdict is ok
+    health = [e for e in evs if e["ev"] == "health"]
+    assert health and all(e["status"] == "ok" for e in health)
+    # metric snapshots at the cadence plus one final pre-run_end scrape
+    snaps = [e for e in evs if e["ev"] == "metrics"]
+    assert len(snaps) >= 2
+    scrape = snaps[-1]["scrape"]
+    assert REGISTRY.counter(
+        "lgbm_train_iterations_total").value == before_iters + 5
+    assert REGISTRY.counter(
+        "lgbm_trees_built_total").value == before_trees + 5
+    assert scrape["lgbm_train_iter_seconds"]["count"] >= 5
+    text = prom.read_text()
+    assert "# TYPE lgbm_train_iterations_total counter" in text
+    assert 'lgbm_train_iter_seconds_bucket{le="' in text
+
+
+def test_nan_gradients_warn_keeps_running(tmp_path):
+    events = tmp_path / "ev.jsonl"
+
+    def fobj(preds, dataset):
+        n = len(dataset.get_label())
+        return np.full(n, np.nan), np.ones(n)
+
+    _train({"obs_events_path": str(events), "obs_health": "warn"},
+           n_rounds=2, fobj=fobj)
+    evs = read_events(str(events))
+    fired = [e for e in evs if e["ev"] == "health"
+             and e["check"] == "nonfinite_gradients"]
+    assert fired and all(e["status"] == "warn" for e in fired)
+    assert evs[-1]["ev"] == "run_end" and evs[-1]["status"] == "ok"
+    assert evs[-1]["health"]["counts"]["warn"] >= 1
+
+
+def test_nan_gradients_fatal_aborts_run(tmp_path):
+    """ISSUE acceptance: injected NaN gradients abort under
+    obs_health=fatal, with the health event persisted in the JSONL."""
+    events = tmp_path / "ev.jsonl"
+
+    def fobj(preds, dataset):
+        n = len(dataset.get_label())
+        return np.full(n, np.nan), np.ones(n)
+
+    with pytest.raises(lgb.LightGBMError, match="obs_health=fatal"):
+        _train({"obs_events_path": str(events), "obs_health": "fatal"},
+               n_rounds=5, fobj=fobj)
+    evs = read_events(str(events))
+    fired = [e for e in evs if e["ev"] == "health"
+             and e["check"] == "nonfinite_gradients"]
+    assert fired and fired[0]["status"] == "fatal"
+    assert evs[-1]["ev"] == "run_end" and evs[-1]["status"] == "aborted"
+
+
+def test_diverging_gradients_warn(tmp_path):
+    events = tmp_path / "ev.jsonl"
+    calls = [0]
+
+    def fobj(preds, dataset):
+        n = len(dataset.get_label())
+        g = np.full(n, 10.0 ** calls[0])
+        calls[0] += 1
+        return g, np.ones(n)
+
+    _train({"obs_events_path": str(events), "obs_health": "warn"},
+           n_rounds=4, fobj=fobj)
+    evs = read_events(str(events))
+    fired = [e for e in evs if e["ev"] == "health"
+             and e["check"] == "loss_divergence"]
+    assert fired and all(e["status"] == "warn" for e in fired)
+
+
+# ------------------------------------------------------ health unit level
+
+def test_divergence_fatal_raises_and_flushes():
+    hm = HealthMonitors(mode="fatal", divergence=3.0)
+    obs = _CollectObs()
+    for it, scale in enumerate((1.0, 10.0)):
+        hm.stage_gradients(np.full(8, scale), np.ones(8))
+        hm.run_checks(obs, it)
+    hm.stage_gradients(np.full(8, 100.0), np.ones(8))
+    with pytest.raises(lgb.LightGBMError):
+        hm.run_checks(obs, 2)
+    assert obs.flushed == 1           # timeline flushed before the raise
+    fatal = [e for e in obs.events if e["ev"] == "health"
+             and e["check"] == "loss_divergence"]
+    assert fatal and fatal[0]["status"] == "fatal"
+
+
+def test_plateau_is_warn_only_even_under_fatal():
+    hm = HealthMonitors(mode="fatal", plateau=2)
+    obs = _CollectObs()
+    for it in range(4):               # constant gradients: EMA flatlines
+        hm.stage_gradients(np.ones(8), np.ones(8))
+        hm.run_checks(obs, it)        # must never raise
+    fired = [e for e in obs.events if e["ev"] == "health"
+             and e["check"] == "plateau"]
+    assert fired and all(e["status"] == "warn" for e in fired)
+
+
+def test_memory_watermark(tmp_path):
+    hm = HealthMonitors(mode="warn", mem_frac=0.9)
+    obs = _CollectObs()
+    rows = [{"id": 0, "bytes_in_use": 95, "bytes_limit": 100},
+            {"id": 1, "bytes_in_use": 10, "bytes_limit": 100}]
+    hm.check_memory(obs, 3, devices=rows)
+    fired = [e for e in obs.events if e["ev"] == "health"
+             and e["check"] == "memory_watermark"]
+    assert len(fired) == 1 and fired[0]["status"] == "warn"
+    assert fired[0]["detail"]["device"] == 0
+    assert hm.summary()["mem_peak_frac"] == {"0": 0.95, "1": 0.1}
+    # CPU-style identity rows (no byte counters) are a no-op
+    hm.check_memory(obs, 4, devices=[{"id": 0}])
+    assert len([e for e in obs.events if e["ev"] == "health"]) == 1
+    # fatal mode raises
+    hm2 = HealthMonitors(mode="fatal", mem_frac=0.9)
+    with pytest.raises(lgb.LightGBMError):
+        hm2.check_memory(_CollectObs(), 0, devices=rows)
+
+
+def test_health_cadence_and_mode_validation():
+    hm = HealthMonitors(mode="warn", every=3)
+    assert [it for it in range(7) if hm.due(it)] == [0, 3, 6]
+    with pytest.raises(ValueError):
+        HealthMonitors(mode="sideways")
+
+
+# ------------------------------------------------------- crash safety
+
+def test_event_writer_context_manager(tmp_path):
+    path = tmp_path / "w.jsonl"
+    with EventWriter(str(path), flush_every=1000) as w:
+        w.emit({"ev": "health", "run": "x", "t": 0.0,
+                "check": "stats", "status": "ok", "it": 0})
+    # closed on exit; the un-flushed tail made it to disk
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["ev"] == "health"
+
+
+def test_run_observer_context_manager(tmp_path):
+    ok_path = tmp_path / "ok.jsonl"
+    with RunObserver(events_path=str(ok_path), timing="off"):
+        pass
+    assert read_events(str(ok_path))[-1]["status"] == "ok"
+    bad_path = tmp_path / "bad.jsonl"
+    with pytest.raises(RuntimeError):
+        with RunObserver(events_path=str(bad_path), timing="off") as obs:
+            obs.event("health", check="stats", status="ok", it=0)
+            raise RuntimeError("boom")
+    evs = read_events(str(bad_path))
+    assert evs[-1]["ev"] == "run_end" and evs[-1]["status"] == "aborted"
+
+
+def test_run_observer_atexit_finalization(tmp_path):
+    path = tmp_path / "crash.jsonl"
+    obs = RunObserver(events_path=str(path), timing="off")
+    obs.event("health", check="stats", status="ok", it=0)
+    obs._finalize_at_exit()           # what atexit runs on a crashed run
+    evs = read_events(str(path))
+    assert evs[-1]["ev"] == "run_end" and evs[-1]["status"] == "aborted"
+    obs._finalize_at_exit()           # idempotent: no second run_end
+    assert len(read_events(str(path))) == len(evs)
+
+
+def test_engine_finalizes_aborted_on_callback_crash(tmp_path):
+    path = tmp_path / "cb.jsonl"
+
+    def bomb(env):
+        raise RuntimeError("callback boom")
+
+    X, y = _data()
+    with pytest.raises(RuntimeError):
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                   "obs_events_path": str(path)},
+                  lgb.Dataset(X, label=y), num_boost_round=5,
+                  callbacks=[bomb], verbose_eval=False)
+    assert read_events(str(path))[-1]["status"] == "aborted"
+
+
+# -------------------------------------------------------- config wiring
+
+def test_observer_from_config_health_and_metrics():
+    assert observer_from_config(Config({})) is NULL_OBSERVER
+    obs = observer_from_config(Config({"obs_health": "warn"}))
+    assert isinstance(obs, RunObserver)
+    assert isinstance(obs.health, HealthMonitors)
+    assert obs.health.mode == "warn"
+    obs.close()
+    obs = observer_from_config(Config({"obs_metrics_every": 3}))
+    assert isinstance(obs, RunObserver) and obs.health is None
+    obs.close()
+    with pytest.raises(lgb.LightGBMError):
+        observer_from_config(Config({"obs_health": "bogus"}))
+    cfg = Config({"obs_health_mode": "fatal", "obs_health_freq": 2,
+                  "obs_metrics_file": "/tmp/m.prom",
+                  "obs_metrics_freq": 5})
+    assert cfg.obs_health == "fatal" and cfg.obs_health_every == 2
+    assert cfg.obs_metrics_path == "/tmp/m.prom"
+    assert cfg.obs_metrics_every == 5
+
+
+# --------------------------------------------------------- bench_compare
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(REPO, "tools", "bench_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _timeline(path, iter_s, first_s=1.0, peak=1000):
+    evs = [{"ev": "run_header", "run": "r", "t": 0.0},
+           {"ev": "iter", "run": "r", "t": 0.0, "time_s": iter_s},
+           {"ev": "iter", "run": "r", "t": 0.0, "time_s": iter_s},
+           {"ev": "memory", "run": "r", "t": 0.0,
+            "devices": [{"id": 0, "bytes_in_use": peak}]},
+           {"ev": "run_end", "run": "r", "t": 0.0,
+            "entries": {"boost": {"first_s": first_s}}}]
+    with open(path, "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+def test_bench_compare_identical_passes(tmp_path):
+    bc = _bench_compare()
+    p = _timeline(tmp_path / "a.jsonl", 0.2)
+    assert bc.main([p, p]) == 0
+    assert bc.load_metrics(p) == {"iters_per_sec": pytest.approx(5.0),
+                                  "compile_s": 1.0,
+                                  "peak_mem_bytes": 1000}
+
+
+def test_bench_compare_regressions(tmp_path):
+    bc = _bench_compare()
+    base = _timeline(tmp_path / "base.jsonl", 0.2)
+    # iters/sec drops 50% -> regression
+    slow = _timeline(tmp_path / "slow.jsonl", 0.4)
+    assert bc.main([base, slow]) == 1
+    # within tolerance passes
+    near = _timeline(tmp_path / "near.jsonl", 0.205)
+    assert bc.main([base, near]) == 0
+    # compile-time regression alone trips too
+    compiley = _timeline(tmp_path / "c.jsonl", 0.2, first_s=2.0)
+    assert bc.main([base, compiley]) == 1
+    # ...unless the tolerance is widened
+    assert bc.main([base, compiley, "--tol-compile", "2.0"]) == 0
+    # memory regression
+    fat = _timeline(tmp_path / "fat.jsonl", 0.2, peak=2000)
+    assert bc.main([base, fat]) == 1
+
+
+def test_bench_compare_lineage_and_child_lines(tmp_path):
+    bc = _bench_compare()
+    lineage = tmp_path / "BENCH_r01.json"
+    lineage.write_text(json.dumps(
+        {"round": 1, "parsed": {"metric": "train_iters_per_sec",
+                                "value": 1.30, "unit": "iters/sec"}}))
+    child = tmp_path / "child.jsonl"
+    child.write_text(json.dumps({"metric": "train_iters_per_sec",
+                                 "value": 1.0, "unit": "iters/sec"}) + "\n")
+    assert bc.main([str(lineage), str(lineage)]) == 0
+    assert bc.main([str(lineage), str(child)]) == 1       # 23% drop
+    assert bc.main([str(child), str(lineage)]) == 0       # improvement
+
+
+def test_bench_compare_usage_errors(tmp_path):
+    bc = _bench_compare()
+    garbage = tmp_path / "garbage.txt"
+    garbage.write_text("not json at all\n")
+    p = _timeline(tmp_path / "a.jsonl", 0.2)
+    assert bc.main([p, str(garbage)]) == 2
+    assert bc.main([p, str(tmp_path / "missing.json")]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert bc.main([p, str(empty)]) == 2                  # no overlap
+
+
+def test_bench_compare_json_verdict(tmp_path, capsys):
+    bc = _bench_compare()
+    base = _timeline(tmp_path / "base.jsonl", 0.2)
+    slow = _timeline(tmp_path / "slow.jsonl", 0.4)
+    assert bc.main([base, slow, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "regression"
+    bad = [m for m in doc["metrics"] if m["regressed"]]
+    assert bad and bad[0]["metric"] == "iters_per_sec"

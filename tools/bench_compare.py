@@ -1,0 +1,205 @@
+"""Compare two bench/timeline artifacts and gate on perf regressions.
+
+CI needs a yes/no answer to "did this PR make the bench slower", not a
+human squinting at BENCH_*.json — the discipline 1809.04559 frames as
+the hard part of GBDT perf work.  This tool loads two artifacts, lines
+up the comparable metrics, applies per-metric tolerances, and exits
+nonzero on regression so a workflow can gate on it.
+
+Accepted artifact kinds (auto-detected per file):
+
+* an obs JSONL timeline (``obs_events_path`` / ``bench.py --dry``) —
+  iters/sec over the LAST run's fenced iter records, compile seconds
+  from the run_end entry summaries (or compile events), peak device
+  memory from memory snapshots (absent on CPU);
+* a ``BENCH_r*.json`` lineage record — ``parsed.value`` with
+  ``parsed.unit`` of iters/sec;
+* a bare bench JSON line — ``{"metric": ..., "value": ...}`` as printed
+  by ``bench.py --child``.
+
+Direction is per metric: iters/sec regresses when the candidate drops
+below baseline x (1 - tol); compile time and peak memory regress when
+the candidate exceeds baseline x (1 + tol).  Metrics present in only one
+artifact are reported and skipped; no overlap at all is a usage error.
+
+Usage:
+    python tools/bench_compare.py BASELINE CANDIDATE \
+        [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] [--json]
+
+Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
+"""
+import argparse
+import json
+import sys
+
+# metric -> (direction, default tolerance); direction +1 = higher is
+# better, -1 = lower is better
+METRICS = {
+    "iters_per_sec": (+1, 0.08),
+    "compile_s": (-1, 0.25),
+    "peak_mem_bytes": (-1, 0.10),
+}
+
+
+def _from_timeline(events):
+    """Metrics of the LAST run in an obs timeline."""
+    run = events[-1].get("run")
+    events = [e for e in events if e.get("run") == run]
+    out = {}
+    iters = [e for e in events if e.get("ev") == "iter"]
+    total = sum(e["time_s"] for e in iters)
+    if iters and total > 0:
+        out["iters_per_sec"] = len(iters) / total
+    run_end = next((e for e in events if e.get("ev") == "run_end"), None)
+    entries = (run_end or {}).get("entries") or {}
+    if entries:
+        out["compile_s"] = sum(st.get("first_s", 0.0)
+                               for st in entries.values())
+    else:
+        compiles = [e for e in events if e.get("ev") == "compile"]
+        if compiles:
+            out["compile_s"] = sum(e["first_call_s"] for e in compiles)
+    peak = 0
+    for e in events:
+        if e.get("ev") != "memory":
+            continue
+        for d in e.get("devices", ()):
+            peak = max(peak, d.get("peak_bytes_in_use",
+                                   d.get("bytes_in_use", 0)))
+    if peak:
+        out["peak_mem_bytes"] = peak
+    return out
+
+
+def _from_parsed(parsed):
+    out = {}
+    unit = str(parsed.get("unit", ""))
+    value = parsed.get("value")
+    if value is None:
+        return out
+    if "iters/sec" in unit or "iters_per_sec" in str(parsed.get("metric",
+                                                                "")):
+        out["iters_per_sec"] = float(value)
+    return out
+
+
+def load_metrics(path):
+    """{metric: value} from any accepted artifact kind."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise SystemExit2("cannot read %s: %s" % (path, e))
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            break
+    else:
+        if records and all(isinstance(r, dict) for r in records):
+            if any(r.get("ev") for r in records):        # obs timeline
+                return _from_timeline(records)
+            for r in reversed(records):   # bench --child / lineage line
+                got = _from_parsed(r["parsed"]
+                                   if isinstance(r.get("parsed"), dict)
+                                   else r)
+                if got:
+                    return got
+            return {}
+    # whole-file JSON (BENCH_r*.json lineage, or an indented export)
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        raise SystemExit2("%s is neither JSONL nor JSON: %s" % (path, e))
+    if isinstance(doc, dict):
+        if isinstance(doc.get("parsed"), dict):
+            return _from_parsed(doc["parsed"])
+        return _from_parsed(doc)
+    return {}
+
+
+class SystemExit2(Exception):
+    """Load/usage failure -> exit 2 (distinct from regression -> 1)."""
+
+
+def compare(base, cand, tols):
+    """[(metric, base, cand, delta_frac, regressed, tol)] over the
+    metrics present in both artifacts."""
+    rows = []
+    for name, (direction, _) in METRICS.items():
+        if name not in base or name not in cand:
+            continue
+        b, c = float(base[name]), float(cand[name])
+        tol = tols[name]
+        if b == 0:
+            delta = 0.0
+            regressed = False
+        else:
+            delta = (c - b) / b
+            regressed = (direction > 0 and c < b * (1.0 - tol)) or \
+                        (direction < 0 and c > b * (1.0 + tol))
+        rows.append((name, b, c, delta, regressed, tol))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="compare two bench/timeline artifacts; nonzero exit "
+                    "on perf regression beyond tolerance")
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--tol-ips", type=float, default=METRICS[
+        "iters_per_sec"][1], help="iters/sec relative tolerance")
+    ap.add_argument("--tol-compile", type=float, default=METRICS[
+        "compile_s"][1], help="compile-time relative tolerance")
+    ap.add_argument("--tol-mem", type=float, default=METRICS[
+        "peak_mem_bytes"][1], help="peak-memory relative tolerance")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdict on stdout")
+    args = ap.parse_args(argv)
+    tols = {"iters_per_sec": args.tol_ips, "compile_s": args.tol_compile,
+            "peak_mem_bytes": args.tol_mem}
+    try:
+        base = load_metrics(args.baseline)
+        cand = load_metrics(args.candidate)
+    except SystemExit2 as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+    rows = compare(base, cand, tols)
+    if not rows:
+        print("error: no comparable metrics between %s (%s) and %s (%s)"
+              % (args.baseline, sorted(base) or "none",
+                 args.candidate, sorted(cand) or "none"), file=sys.stderr)
+        return 2
+    regressed = [r for r in rows if r[4]]
+    if args.json:
+        print(json.dumps({
+            "status": "regression" if regressed else "ok",
+            "metrics": [{"metric": n, "baseline": b, "candidate": c,
+                         "delta_frac": round(d, 6), "tolerance": t,
+                         "regressed": r}
+                        for n, b, c, d, r, t in rows]}))
+    else:
+        print("%-16s %14s %14s %9s %7s  verdict"
+              % ("metric", "baseline", "candidate", "delta", "tol"))
+        for n, b, c, d, r, t in rows:
+            print("%-16s %14.6g %14.6g %+8.2f%% %6.0f%%  %s"
+                  % (n, b, c, 100 * d, 100 * t,
+                     "REGRESSED" if r else "ok"))
+        skipped = (set(base) | set(cand)) - {r[0] for r in rows}
+        if skipped:
+            print("skipped (present in only one artifact): %s"
+                  % ", ".join(sorted(skipped)))
+    if regressed:
+        print("FAIL: %d metric(s) regressed beyond tolerance"
+              % len(regressed), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

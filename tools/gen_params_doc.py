@@ -127,6 +127,26 @@ NOTES = {
                        "iterations [a, b) (requires obs_trace_dir)",
     "obs_trace_dir": "destination of the obs_trace_iters profiler window",
     "obs_flush_every": "flush the JSONL event writer every N events",
+    "obs_health": "off / warn / fatal — training health monitors "
+                  "(non-finite gradients/hessians/leaf values, EMA loss "
+                  "divergence, plateau, memory watermark); warn logs + "
+                  "emits health events, fatal additionally aborts the run",
+    "obs_health_every": "run the health checks every N iterations",
+    "obs_health_divergence": "fire loss_divergence when the gradient "
+                             "magnitude exceeds this factor x its EMA on "
+                             "two consecutive checks (0 = off)",
+    "obs_health_plateau": "fire plateau (warn-only) after N consecutive "
+                          "checks with relative EMA movement under 1e-4 "
+                          "(0 = off)",
+    "obs_health_mem_frac": "memory_watermark threshold: per-device "
+                           "bytes_in_use / bytes_limit (0 = off; no-op "
+                           "on backends without byte counters)",
+    "obs_metrics_path": "export the process metrics registry at run end: "
+                        ".prom/.txt = Prometheus textfile format, "
+                        "otherwise JSON",
+    "obs_metrics_every": "embed a metrics snapshot event into the "
+                         "timeline every N iterations (0 = final "
+                         "snapshot only when obs_metrics_path is set)",
 }
 
 GROUPS = [
@@ -170,7 +190,10 @@ GROUPS = [
         "tpu_profile_dir"]),
     ("Observability", [
         "obs_events_path", "obs_timing", "obs_memory_every",
-        "obs_trace_iters", "obs_trace_dir", "obs_flush_every"]),
+        "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
+        "obs_health", "obs_health_every", "obs_health_divergence",
+        "obs_health_plateau", "obs_health_mem_frac", "obs_metrics_path",
+        "obs_metrics_every"]),
 ]
 
 

@@ -64,6 +64,9 @@ def summarize_jsonl(path, csv=False, out=None):
             phase_totals[k] += v
     total_s = sum(e["time_s"] for e in iters)
     entries = (run_end or {}).get("entries", {})
+    health = [e for e in events if e["ev"] == "health"]
+    metric_evs = [e for e in events if e["ev"] == "metrics"]
+    scrape = metric_evs[-1]["scrape"] if metric_evs else {}
 
     if csv:
         w = out.write
@@ -77,6 +80,16 @@ def summarize_jsonl(path, csv=False, out=None):
             w("entry_execute,%s,%.6f,%.6f,%d,steady_state\n"
               % (name, st["exec_total_s"], st["exec_mean_s"],
                  st["exec_n"]))
+        hc = collections.Counter((e["check"], e["status"]) for e in health)
+        for (check, status), n in sorted(hc.items()):
+            w("health,%s,,,%d,%s\n" % (check, n, status))
+        for name, m in sorted(scrape.items()):
+            if m.get("type") == "histogram":
+                w("metric,%s,%.6f,,%d,histogram\n"
+                  % (name, m["sum"], m["count"]))
+            else:
+                w("metric,%s,%.6f,,1,%s\n"
+                  % (name, float(m["value"]), m.get("type", "")))
         return
 
     w = lambda s="": out.write(s + "\n")
@@ -122,6 +135,30 @@ def summarize_jsonl(path, csv=False, out=None):
         w("\n== peak device memory ==")
         for did, b in sorted(peaks.items()):
             w("  device %d: %.1f MiB" % (did, b / 2**20))
+
+    if health:
+        hc = collections.Counter((e["check"], e["status"]) for e in health)
+        w("\n== health (%d events, run ended %s) ==" % (
+            len(health), (run_end or {}).get("status", "?")))
+        w("  %6s %8s  %s" % ("count", "status", "check"))
+        for (check, status), n in sorted(hc.items()):
+            w("  %6d %8s  %s" % (n, status, check))
+        fired = [e for e in health if e["status"] != "ok"]
+        for e in fired[:20]:
+            w("  it %-5d %s/%s: %s" % (e["it"], e["check"], e["status"],
+                                       e.get("detail", {})))
+        if len(fired) > 20:
+            w("  ... %d more non-ok health events" % (len(fired) - 20))
+
+    if scrape:
+        w("\n== final metrics snapshot (it %s) ==" % metric_evs[-1]["it"])
+        for name, m in sorted(scrape.items()):
+            if m.get("type") == "histogram":
+                mean = m["sum"] / m["count"] if m["count"] else 0.0
+                w("  %-34s count=%d sum=%.4f mean=%.5f"
+                  % (name, m["count"], m["sum"], mean))
+            else:
+                w("  %-34s %s" % (name, m["value"]))
 
 
 def main():
