@@ -436,14 +436,19 @@ def dry():
     params = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
               "verbose": -1, "obs_events_path": obs_path,
               "obs_timing": "iter", "obs_memory_every": 2,
-              "obs_health": "warn", "obs_metrics_every": 2}
+              "obs_health": "warn", "obs_metrics_every": 2,
+              "obs_compile": True}
     lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
 
     evs = read_events(obs_path)          # validates every record
     kinds = [e["ev"] for e in evs]
-    for need in ("run_header", "iter", "compile", "memory", "health",
-                 "metrics", "run_end"):
+    for need in ("run_header", "iter", "compile", "compile_attr",
+                 "memory", "health", "metrics", "run_end"):
         assert need in kinds, "timeline missing %r events" % need
+    attr = [e for e in evs if e["ev"] == "compile_attr"]
+    thrash = [e for e in attr if e.get("sig_compiles", 1) > 1]
+    assert not thrash, "shape-stable dry run recompiled an already-" \
+        "compiled signature (jit-cache thrash): %r" % thrash
     iter_recs = [e for e in evs if e["ev"] == "iter"]
     assert len(iter_recs) == 5, "expected 5 iter records, got %d" \
         % len(iter_recs)
@@ -460,7 +465,8 @@ def dry():
     assert end.get("status") == "ok", "clean dry run must end status=ok"
     print(json.dumps({"status": "dry_ok", "events": len(evs),
                       "iters": len(iter_recs), "health": len(health),
-                      "metrics": len(metric_recs), "path": obs_path}))
+                      "metrics": len(metric_recs),
+                      "compile_attr": len(attr), "path": obs_path}))
 
 
 if __name__ == "__main__":

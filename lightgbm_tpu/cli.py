@@ -115,8 +115,9 @@ def run_train(cfg: Config) -> None:
         # failed run still leaves a readable timeline (status=aborted)
         booster._obs.close(status="ok" if finished else "aborted")
     if cfg.obs_events_path:
-        Log.info("Telemetry timeline -> %s (summarize with "
-                 "tools/trace_summary.py)", cfg.obs_events_path)
+        Log.info("Telemetry timeline -> %s (query with `python -m "
+                 "lightgbm_tpu obs summary %s`)", cfg.obs_events_path,
+                 cfg.obs_events_path)
     if cfg.obs_metrics_path:
         Log.info("Metrics export -> %s", cfg.obs_metrics_path)
     booster.save_model_to_file(cfg.output_model)
@@ -158,6 +159,12 @@ def run_convert_model(cfg: Config) -> None:
 
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "obs":
+        # timeline query subcommand (docs/Observability.md):
+        #   python -m lightgbm_tpu obs summary|recompiles|stragglers|
+        #                              diff|trace ...
+        from .obs.query import main as obs_main
+        return obs_main(argv[1:])
     params = parse_cli_params(argv)
     params = key_alias_transform(params, raise_unknown=False)
     cfg = Config(params)

@@ -12,7 +12,7 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset, _InnerPredictor
 from .utils.config import key_alias_transform
-from .utils.log import LightGBMError
+from .utils.log import LightGBMError, Log
 
 __all__ = ["train", "cv"]
 
@@ -129,6 +129,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     for dataset_name, eval_name, score, _ in evaluation_result_list or []:
         booster.best_score[dataset_name][eval_name] = score
     booster.finalize_telemetry()
+    ep = str(params.get("obs_events_path", "") or "")
+    if ep:
+        Log.debug("obs: timeline %s (query: python -m lightgbm_tpu obs "
+                  "summary %s)", ep, ep)
     return booster
 
 

@@ -472,11 +472,13 @@ class GBDT:
             health_leaves = []
 
         num_leaves_this_iter = []
+        last_leaf_id = None
         for tid in range(k):
             if self.class_need_train[tid]:
                 dev_tree, leaf_id = self.learner.train_device(g_dev[tid],
                                                               h_dev[tid],
                                                               self.row_mult)
+                last_leaf_id = leaf_id
                 # "grow" = the fused histogram+split+partition XLA program
                 # (one jitted entry; finer decomposition needs a profiler
                 # window — see docs/Observability.md)
@@ -533,6 +535,13 @@ class GBDT:
             # LightGBMError under obs_health=fatal
             health.stage_leaf_values(health_leaves)
             health.run_checks(obs, it0)
+
+        if last_leaf_id is not None:
+            # straggler sampling (obs/straggler.py, obs_straggler_every):
+            # the row->leaf map is the iteration's most row-sharded
+            # artifact, so its per-shard arrival order exposes which
+            # device the collectives waited on
+            obs.straggler_sample(it0, last_leaf_id)
 
         # stop check: any trained tree must have >1 leaves.  Evaluating the
         # device scalars here costs one sync; skip it when nothing forces a

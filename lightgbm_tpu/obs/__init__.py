@@ -19,12 +19,21 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   Prometheus-textfile and JSON export (``obs_metrics_path`` /
   ``obs_metrics_every``);
 * ``health``  — non-finite guards, EMA loss divergence/plateau, memory
-  watermark (``obs_health=off/warn/fatal``).
+  watermark (``obs_health=off/warn/fatal``);
+* ``compile`` — XLA compile-cache introspection: per-entry compile
+  counts, signature diffs naming the offending axis, cost/memory
+  analysis (``obs_compile=true`` -> schema-v3 ``compile_attr`` events);
+* ``straggler`` — sampled per-shard arrival-skew profiling of the
+  distributed learners (``obs_straggler_every`` /
+  ``obs_straggler_warn_skew``);
+* ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
+  obs summary|recompiles|stragglers|diff|trace``.
 
 Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_memory_every``, ``obs_trace_iters``, ``obs_trace_dir``,
-``obs_flush_every``, ``obs_health*``, ``obs_metrics*``.  See
-docs/Observability.md for the schema.
+``obs_flush_every``, ``obs_health*``, ``obs_metrics*``,
+``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``.
+See docs/Observability.md for the schema.
 """
 from __future__ import annotations
 
@@ -56,7 +65,8 @@ def observer_from_config(config):
 
     Any of ``obs_events_path`` / ``obs_trace_iters`` / ``obs_memory_every``
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
-    ``obs_metrics_every`` enables the observer; health and metrics work
+    ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every``
+    enables the observer; health, metrics and compile tracking work
     without an events path (in-memory timeline via Booster.telemetry()).
     """
     events_path = str(getattr(config, "obs_events_path", "") or "")
@@ -69,9 +79,12 @@ def observer_from_config(config):
                   health_mode)
     metrics_path = str(getattr(config, "obs_metrics_path", "") or "")
     metrics_every = int(getattr(config, "obs_metrics_every", 0) or 0)
+    compile_attr = bool(getattr(config, "obs_compile", False))
+    straggler_every = int(getattr(config, "obs_straggler_every", 0) or 0)
     if (not events_path and not trace_iters and memory_every <= 0
             and health_mode == "off" and not metrics_path
-            and metrics_every <= 0):
+            and metrics_every <= 0 and not compile_attr
+            and straggler_every <= 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -99,4 +112,9 @@ def observer_from_config(config):
                        flush_every=int(getattr(config, "obs_flush_every",
                                                16) or 16),
                        health=health, metrics_every=metrics_every,
-                       metrics_path=metrics_path)
+                       metrics_path=metrics_path,
+                       compile_attr=compile_attr,
+                       straggler_every=straggler_every,
+                       straggler_warn_skew=float(
+                           getattr(config, "obs_straggler_warn_skew",
+                                   0.5) or 0.5))

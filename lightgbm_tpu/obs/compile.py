@@ -1,0 +1,313 @@
+"""XLA compile-cache introspection and recompile attribution.
+
+A jitted entry point recompiles when the abstract signature of its
+arguments changes — a shape, a dtype, a weak-type promotion, or the
+donation set — or when the caller rebuilt the jitted program object
+itself (new learner, new ``num_leaves``).  On TPU either case costs
+seconds of XLA time per occurrence, and a *recompile storm* (the same
+entry bouncing between signatures every iteration) silently dominates
+small-tree runs: the launch/compile overhead regime both GPU boosting
+papers single out (arxiv 1806.11248 §4, 1809.04559 §5).
+
+``CompileTracker`` hangs off the RunObserver (``obs_compile=true``) and
+watches every registered entry:
+
+* ``before_call`` snapshots the argument signature and the entry's jit
+  cache size (``PjitFunction._cache_size`` where available);
+* ``after_call`` detects a compile (cache growth, or an unseen
+  signature when the cache is unreadable), diffs the signature against
+  the previous *compiled* one so the event names the offending axis,
+  and attaches ``Compiled.cost_analysis()`` / ``memory_analysis()``
+  FLOPs + memory estimates from the AOT lowering path;
+* every compile lands in the timeline as a schema-v3 ``compile_attr``
+  event and bumps the ``lgbm_entry_compiles_total`` /
+  ``lgbm_entry_compile_cache_size`` registry instruments.
+
+``sig_compiles`` on the event counts compiles of the *same* signature —
+anything above 1 means the XLA cache is being evicted or the program
+object is being rebuilt per call, the thrash case the CI gate
+(``python -m lightgbm_tpu obs recompiles --check``) fails on.
+
+Everything here is best-effort instrumentation: a signature that cannot
+be read or an AOT analysis that fails degrades to a smaller event, never
+to a broken training run.
+"""
+from __future__ import annotations
+
+from ..utils.log import Log
+
+# default labels for the top-level positions of a registered entry call
+_POS = "a%d"
+
+
+def _leaf_descr(leaf):
+    """(kind, shape, dtype) of one flattened argument leaf."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("array", tuple(int(d) for d in shape), str(dtype))
+    return ("static", (), repr(leaf))
+
+
+def arg_signature(args, names=None, donate=()):
+    """Flatten ``args`` into a tuple of per-leaf descriptors.
+
+    Each descriptor is ``(label, kind, shape, dtype, donated)`` where
+    ``label`` is ``<top-level name><sub-path>`` (``names`` labels the
+    top-level positions; pytree leaves below keep their key path) —
+    hashable, order-stable, and cheap enough to compute per call.
+    """
+    import jax
+
+    donate = frozenset(donate)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tuple(args))
+    sig = []
+    for path, leaf in flat:
+        idx = getattr(path[0], "idx", None) if path else None
+        if names is not None and idx is not None and idx < len(names):
+            label = names[idx]
+        else:
+            label = _POS % (idx if idx is not None else 0)
+        if len(path) > 1:
+            label += jax.tree_util.keystr(path[1:])
+        kind, shape, dtype = _leaf_descr(leaf)
+        sig.append((label, kind, shape, dtype, idx in donate))
+    return tuple(sig)
+
+
+def render_signature(sig):
+    """Compact human/JSON form: {label: 'float32[2000,8]'}."""
+    out = {}
+    for label, kind, shape, dtype, donated in sig:
+        if kind == "array":
+            s = "%s[%s]" % (dtype, ",".join(str(d) for d in shape))
+        else:
+            s = "static:%s" % (dtype,)
+        if donated:
+            s += " (donated)"
+        out[label] = s
+    return out
+
+
+def diff_signatures(prev, cur):
+    """Name what changed between two signatures, one dict per change.
+
+    Shape changes are reported per axis (``axis``/``before``/``after``)
+    so the event can say *which dimension* moved — the actionable bit
+    when hunting a shape-unstable input.
+    """
+    if prev is None:
+        return []
+    a = {leaf[0]: leaf for leaf in prev}
+    b = {leaf[0]: leaf for leaf in cur}
+    diff = []
+    for label in a.keys() - b.keys():
+        diff.append({"arg": label, "field": "removed"})
+    for label in b.keys() - a.keys():
+        diff.append({"arg": label, "field": "added", "after":
+                     render_signature((b[label],))[label]})
+    for label in a.keys() & b.keys():
+        _, kind_a, shape_a, dtype_a, don_a = a[label]
+        _, kind_b, shape_b, dtype_b, don_b = b[label]
+        if kind_a != kind_b:
+            diff.append({"arg": label, "field": "kind",
+                         "before": kind_a, "after": kind_b})
+            continue
+        if kind_a == "static":
+            if dtype_a != dtype_b:
+                diff.append({"arg": label, "field": "value",
+                             "before": dtype_a, "after": dtype_b})
+            continue
+        if len(shape_a) != len(shape_b):
+            diff.append({"arg": label, "field": "rank",
+                         "before": len(shape_a), "after": len(shape_b)})
+        else:
+            for axis, (da, db) in enumerate(zip(shape_a, shape_b)):
+                if da != db:
+                    diff.append({"arg": label, "field": "shape",
+                                 "axis": axis, "before": da, "after": db})
+        if dtype_a != dtype_b:
+            diff.append({"arg": label, "field": "dtype",
+                         "before": dtype_a, "after": dtype_b})
+        if don_a != don_b:
+            diff.append({"arg": label, "field": "donated",
+                         "before": don_a, "after": don_b})
+    return diff
+
+
+def format_diff(d):
+    """One change dict -> one human-readable clause."""
+    arg = d.get("arg", "?")
+    field = d.get("field", "?")
+    if field == "shape":
+        return "%s.shape[%d]: %s -> %s" % (arg, d.get("axis", -1),
+                                           d.get("before"), d.get("after"))
+    if field == "program":
+        return d.get("note", "program object rebuilt")
+    if field in ("added", "removed"):
+        return "%s %s" % (arg, field)
+    return "%s.%s: %s -> %s" % (arg, field, d.get("before"),
+                                d.get("after"))
+
+
+def _cache_size(fn):
+    """The entry's jit-cache entry count, or None when unreadable."""
+    try:
+        getter = fn._cache_size
+    except AttributeError:
+        return None
+    try:
+        return int(getter())
+    except Exception:
+        return None
+
+
+def analyze_compiled(fn, args):
+    """FLOPs + memory estimates via the AOT path (fn.lower().compile()).
+
+    ``cost_analysis`` returns a list of per-program dicts on recent jax
+    CPU backends and a bare dict elsewhere; ``memory_analysis`` returns a
+    ``CompiledMemoryStats``.  Both are optional per backend, so every
+    step is guarded — analysis failure only shrinks the event.
+    """
+    out = {}
+    try:
+        compiled = fn.lower(*args).compile()
+    except Exception as e:                      # non-jit entry, AOT refusal
+        Log.debug("obs: compile analysis unavailable for %r: %s", fn, e)
+        return out
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            cost = {}
+            if "flops" in ca:
+                cost["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                cost["bytes_accessed"] = float(ca["bytes accessed"])
+            if cost:
+                out["cost"] = cost
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        mem = {}
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, field, None)
+            if v is not None:
+                mem[field.replace("_size_in_bytes", "_bytes")] = int(v)
+        if mem:
+            out["memory"] = mem
+    except Exception:
+        pass
+    return out
+
+
+class CompileTracker:
+    """Per-entry compile-cache state machine driven by the observer's
+    ``entry_args`` (before the call) / ``entry_end`` (after) hooks."""
+
+    def __init__(self, registry=None, analyze=True):
+        if registry is None:
+            from .metrics import REGISTRY
+            registry = REGISTRY
+        self._registry = registry
+        self._analyze = bool(analyze)
+        self._entries = {}        # name -> state dict
+        self._pending = {}        # name -> (fn, args, sig, cache_before)
+
+    def before_call(self, name, fn, args, names=None, donate=()):
+        try:
+            sig = arg_signature(args, names=names, donate=donate)
+        except Exception as e:    # exotic pytree: never break the call
+            Log.debug("obs: signature of entry %s unreadable: %s", name, e)
+            self._pending.pop(name, None)
+            return
+        self._pending[name] = (fn, args, sig, _cache_size(fn))
+
+    def after_call(self, name, obs):
+        pending = self._pending.pop(name, None)
+        if pending is None:
+            return
+        fn, args, sig, cache0 = pending
+        st = self._entries.setdefault(name, {
+            "calls": 0, "compiles": 0, "sig_compiles": {},
+            "last_compiled_sig": None, "fn_id": None})
+        st["calls"] += 1
+        cache1 = _cache_size(fn)
+        rebuilt = st["fn_id"] is not None and st["fn_id"] != id(fn)
+        st["fn_id"] = id(fn)
+        if cache0 is not None and cache1 is not None:
+            compiled = cache1 > cache0
+        else:
+            # no cache introspection: fall back to signature novelty
+            compiled = rebuilt or sig not in st["sig_compiles"]
+        if not compiled:
+            return
+        st["compiles"] += 1
+        n_sig = st["sig_compiles"].get(sig, 0) + 1
+        st["sig_compiles"][sig] = n_sig
+        diff = diff_signatures(st["last_compiled_sig"], sig)
+        if rebuilt:
+            diff.insert(0, {"field": "program",
+                            "note": "entry rebuilt (new jitted program "
+                                    "object)"})
+        fields = {"entry": name, "n_compiles": st["compiles"],
+                  "sig": render_signature(sig), "sig_compiles": n_sig,
+                  "diff": diff}
+        if cache1 is not None:
+            fields["cache_size"] = cache1
+        if self._analyze:
+            fields.update(analyze_compiled(fn, args))
+        st["last_compiled_sig"] = sig
+        obs.event("compile_attr", **fields)
+        labels = {"entry": name}
+        self._registry.counter(
+            "lgbm_entry_compiles_total",
+            "XLA compiles per registered jitted entry point",
+            labels=labels).inc()
+        if cache1 is not None:
+            self._registry.gauge(
+                "lgbm_entry_compile_cache_size",
+                "live jit-cache entries per registered entry point",
+                labels=labels).set(cache1)
+        cost = fields.get("cost") or {}
+        if "flops" in cost:
+            self._registry.gauge(
+                "lgbm_entry_flops",
+                "XLA cost-analysis FLOPs estimate of the last compile",
+                labels=labels).set(cost["flops"])
+        mem = fields.get("memory") or {}
+        if mem:
+            self._registry.gauge(
+                "lgbm_entry_memory_bytes",
+                "argument+output+temp bytes of the last compiled "
+                "program (memory_analysis)",
+                labels=labels).set(
+                    mem.get("argument_bytes", 0)
+                    + mem.get("output_bytes", 0)
+                    + mem.get("temp_bytes", 0))
+        if n_sig > 1:
+            Log.warning("obs: entry %s recompiled signature it already "
+                        "compiled (%d times) — jit-cache thrash", name,
+                        n_sig)
+        elif st["compiles"] > 1:
+            Log.warning("obs: entry %s recompiled (compile #%d): %s",
+                        name, st["compiles"],
+                        "; ".join(format_diff(d) for d in diff)
+                        or "signature unchanged")
+
+    def summary(self):
+        """Folded into run_end: per-entry compile/call/signature counts."""
+        out = {}
+        for name, st in self._entries.items():
+            out[name] = {
+                "calls": st["calls"],
+                "compiles": st["compiles"],
+                "signatures": len(st["sig_compiles"]),
+                "max_sig_compiles": max(st["sig_compiles"].values(),
+                                        default=0),
+            }
+        return out

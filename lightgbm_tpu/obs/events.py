@@ -11,6 +11,10 @@ Event types and their required keys (beyond ev/t/run):
 run_header     schema, backend, devices, params, context, timing
 iter           it, time_s, phases, fenced
 compile        entry, first_call_s, fenced
+compile_attr   entry, n_compiles, sig (schema 3; obs/compile.py — per-
+               compile signature, axis-level diff, cost/memory analysis)
+straggler      it, devices, skew (schema 3; obs/straggler.py — per-shard
+               arrival waits + slowest-device attribution)
 memory         it, devices
 trace_window   action, dir, it
 collectives    learner (plus learner-specific topology/byte estimates)
@@ -42,9 +46,10 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 2
-# schema 1 timelines (no health/metrics events) still parse
-_ACCEPTED_SCHEMAS = (1, 2)
+SCHEMA_VERSION = 3
+# schema 1 (no health/metrics) and 2 (no compile_attr/straggler)
+# timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -52,6 +57,8 @@ _REQUIRED = {
                    "timing"),
     "iter": ("it", "time_s", "phases", "fenced"),
     "compile": ("entry", "first_call_s", "fenced"),
+    "compile_attr": ("entry", "n_compiles", "sig"),
+    "straggler": ("it", "devices", "skew"),
     "memory": ("it", "devices"),
     "trace_window": ("action", "dir", "it"),
     "collectives": ("learner",),
@@ -61,13 +68,21 @@ _REQUIRED = {
 }
 
 
-def validate_event(rec):
-    """Raise ValueError unless ``rec`` is a schema-valid event dict."""
+def validate_event(rec, strict=False):
+    """Raise ValueError unless ``rec`` is a schema-valid event dict.
+
+    Unknown event types pass untouched by default — a v3 reader must not
+    choke on a v4 timeline (forward compatibility is why the schema is
+    versioned at all).  ``strict=True`` additionally rejects unknown
+    ``ev`` values, for writers validating their own output.
+    """
     if not isinstance(rec, dict):
         raise ValueError("event is not a dict: %r" % (rec,))
     ev = rec.get("ev")
     if ev not in _REQUIRED:
-        raise ValueError("unknown event type %r" % (ev,))
+        if strict:
+            raise ValueError("unknown event type %r" % (ev,))
+        return rec
     for key in ("t", "run"):
         if key not in rec:
             raise ValueError("event %r missing %r" % (ev, key))
@@ -160,7 +175,13 @@ class NullObserver:
     def entry_start(self):
         return 0.0
 
+    def entry_args(self, name, fn, args, names=None, donate=()):
+        pass
+
     def entry_end(self, name, t0, value=None):
+        pass
+
+    def straggler_sample(self, it, value):
         pass
 
     def memory_snapshot(self, it):
@@ -193,7 +214,9 @@ class RunObserver(NullObserver):
 
     def __init__(self, events_path="", timing="phase", memory_every=0,
                  trace_iters="", trace_dir="", flush_every=16,
-                 health=None, metrics_every=0, metrics_path=""):
+                 health=None, metrics_every=0, metrics_path="",
+                 compile_attr=False, straggler_every=0,
+                 straggler_warn_skew=0.5):
         from . import metrics as metrics_mod
         self.run_id = os.urandom(4).hex()
         self.timing = timing
@@ -210,6 +233,16 @@ class RunObserver(NullObserver):
         self._metrics_every = max(0, int(metrics_every))
         self._metrics_path = str(metrics_path or "")
         self._registry = metrics_mod.REGISTRY
+        self._compile = None
+        if compile_attr:
+            from .compile import CompileTracker
+            self._compile = CompileTracker(self._registry)
+        self._straggler = None
+        if int(straggler_every or 0) > 0:
+            from .straggler import StragglerProfiler
+            self._straggler = StragglerProfiler(
+                every=straggler_every, warn_skew=straggler_warn_skew,
+                registry=self._registry)
         self._m_iter_s = self._registry.histogram(
             "lgbm_train_iter_seconds",
             "per-iteration wall time as timed by the run observer "
@@ -272,6 +305,14 @@ class RunObserver(NullObserver):
     def entry_start(self):
         return time.perf_counter()
 
+    def entry_args(self, name, fn, args, names=None, donate=()):
+        """Pre-call hook (obs_compile): snapshot the entry's argument
+        signature and jit-cache size so entry_end can attribute a
+        recompile to the axis/dtype/donation that changed."""
+        if self._compile is not None:
+            self._compile.before_call(name, fn, args, names=names,
+                                      donate=donate)
+
     def entry_end(self, name, t0, value=None):
         fenced = self.timing == "phase"
         if fenced:
@@ -279,6 +320,14 @@ class RunObserver(NullObserver):
         dt = time.perf_counter() - t0
         if self._entries.record(name, dt):
             self.event("compile", entry=name, first_call_s=dt, fenced=fenced)
+        if self._compile is not None:
+            self._compile.after_call(name, self)
+
+    def straggler_sample(self, it, value):
+        """Sampled per-shard arrival timing (obs_straggler_every); a
+        fence, so the profiler's cadence gates it."""
+        if self._straggler is not None and self._straggler.due(it):
+            self._straggler.sample(self, it, value)
 
     # -- misc ----------------------------------------------------------
     def memory_snapshot(self, it):
@@ -305,6 +354,10 @@ class RunObserver(NullObserver):
                "entries": self._entries.summary(), "status": status}
         if self.health is not None:
             end["health"] = self.health.summary()
+        if self._compile is not None:
+            end["compile_attr"] = self._compile.summary()
+        if self._straggler is not None:
+            end["stragglers"] = self._straggler.summary()
         self.event("run_end", **end)
         if self._metrics_path:
             try:

@@ -1,0 +1,377 @@
+"""Timeline query layer + the ``python -m lightgbm_tpu obs`` CLI.
+
+One reader for every consumer of an obs JSONL timeline: this module
+loads/validates a file, groups it into runs, reduces a run to headline
+metrics, and renders the query subcommands — so ``tools/trace_summary``
+and the CLI share one ingest path instead of each re-parsing JSONL.
+
+Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
+
+* ``summary RUN.jsonl``       — headline table of the last run;
+* ``recompiles RUN.jsonl``    — every ``compile_attr`` event with its
+  signature diff; ``--check`` exits 1 on same-signature recompiles
+  (jit-cache thrash), the CI gate;
+* ``stragglers RUN.jsonl``    — per-sample skew + slowest-device
+  attribution from ``straggler`` events;
+* ``diff A.jsonl B.jsonl``    — headline metrics of two timelines side
+  by side with deltas (informational; ``tools/bench_compare.py`` is the
+  tolerance-gated verdict);
+* ``trace RUN.jsonl -o t.json`` — Chrome/Perfetto ``trace.json``
+  reconstructed from the phase-timer laps (load in ui.perfetto.dev).
+
+Schema v1/v2 timelines load unchanged — the new event types simply
+don't appear.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .events import read_events
+
+
+def load_timeline(path, validate=True):
+    """Parse + (non-strictly) validate a JSONL timeline."""
+    return read_events(path, validate=validate)
+
+
+def runs(events):
+    """{run_id: [events]} in first-appearance order (cv folds and
+    repeated bench children share one file)."""
+    out = {}
+    for e in events:
+        out.setdefault(e.get("run"), []).append(e)
+    return out
+
+
+def last_run(events):
+    """Events of the run the file's final record belongs to."""
+    if not events:
+        return []
+    run = events[-1].get("run")
+    return [e for e in events if e.get("run") == run]
+
+
+def recompile_rows(events):
+    """Flat view of the ``compile_attr`` events of one run."""
+    rows = []
+    for e in events:
+        if e.get("ev") != "compile_attr":
+            continue
+        rows.append({"entry": e.get("entry"),
+                     "n_compiles": int(e.get("n_compiles", 1)),
+                     "sig_compiles": int(e.get("sig_compiles", 1)),
+                     "sig": e.get("sig", {}),
+                     "diff": e.get("diff", []),
+                     "cost": e.get("cost", {}),
+                     "memory": e.get("memory", {}),
+                     "t": e.get("t")})
+    return rows
+
+
+def straggler_rows(events):
+    return [e for e in events if e.get("ev") == "straggler"]
+
+
+def recompile_count(events):
+    """Compiles beyond the first, per entry, summed — the gated metric."""
+    worst = {}
+    for r in recompile_rows(events):
+        worst[r["entry"]] = max(worst.get(r["entry"], 0), r["n_compiles"])
+    return sum(n - 1 for n in worst.values())
+
+
+def timeline_metrics(events):
+    """Headline metrics of ONE run's events (use last_run() first)."""
+    out = {}
+    if not events:
+        return out
+    out["run"] = events[-1].get("run")
+    header = next((e for e in events if e.get("ev") == "run_header"), None)
+    if header:
+        out["backend"] = header.get("backend")
+        out["schema"] = header.get("schema")
+        out["devices"] = len(header.get("devices", []))
+        out["timing"] = header.get("timing")
+    iters = [e for e in events if e.get("ev") == "iter"]
+    total = sum(e["time_s"] for e in iters)
+    out["iters"] = len(iters)
+    out["total_s"] = total
+    if iters and total > 0:
+        out["iters_per_sec"] = len(iters) / total
+    phase_totals = {}
+    for e in iters:
+        for k, v in e.get("phases", {}).items():
+            phase_totals[k] = phase_totals.get(k, 0.0) + v
+    out["phase_totals"] = phase_totals
+    run_end = next((e for e in events if e.get("ev") == "run_end"), None)
+    entries = (run_end or {}).get("entries") or {}
+    if entries:
+        out["compile_s"] = sum(st.get("first_s", 0.0)
+                               for st in entries.values())
+    else:
+        compiles = [e for e in events if e.get("ev") == "compile"]
+        if compiles:
+            out["compile_s"] = sum(e["first_call_s"] for e in compiles)
+    out["entries"] = entries
+    if any(e.get("ev") == "compile_attr" for e in events):
+        out["recompile_count"] = recompile_count(events)
+    peak = 0
+    for e in events:
+        if e.get("ev") != "memory":
+            continue
+        for d in e.get("devices", ()):
+            peak = max(peak, d.get("peak_bytes_in_use",
+                                   d.get("bytes_in_use", 0)))
+    if peak:
+        out["peak_mem_bytes"] = peak
+    health = [e for e in events if e.get("ev") == "health"]
+    if health:
+        counts = {}
+        for e in health:
+            counts[e.get("status")] = counts.get(e.get("status"), 0) + 1
+        out["health"] = counts
+    stragglers = straggler_rows(events)
+    if stragglers:
+        out["straggler_samples"] = len(stragglers)
+        out["straggler_max_skew"] = max(e.get("skew", 0.0)
+                                        for e in stragglers)
+    if run_end:
+        out["status"] = run_end.get("status", "ok")
+        if "stragglers" in run_end:
+            out["stragglers"] = run_end["stragglers"]
+    return out
+
+
+# ------------------------------------------------------------- rendering
+
+def render_summary(events, out=None):
+    out = out or sys.stdout
+    w = lambda s="": out.write(s + "\n")
+    m = timeline_metrics(events)
+    if not m:
+        w("empty timeline")
+        return
+    w("run %s  schema %s  backend %s  devices %s  timing %s  status %s"
+      % (m.get("run"), m.get("schema", "?"), m.get("backend", "?"),
+         m.get("devices", "?"), m.get("timing", "?"),
+         m.get("status", "?")))
+    ips = (" (%.3f iters/sec)" % m["iters_per_sec"]
+           if "iters_per_sec" in m else "")
+    w("iters %d  total %.3f s%s" % (m["iters"], m["total_s"], ips))
+    totals = m.get("phase_totals") or {}
+    tot = sum(totals.values())
+    if totals and tot > 0:
+        w("phases: " + "  ".join(
+            "%s %.1f%%" % (k, 100.0 * v / tot)
+            for k, v in sorted(totals.items(), key=lambda kv: -kv[1])))
+    for name, st in sorted((m.get("entries") or {}).items()):
+        w("entry %s: first %.3f s, exec %.4f s x %d"
+          % (name, st.get("first_s", 0.0), st.get("exec_mean_s", 0.0),
+             st.get("exec_n", 0)))
+    if "recompile_count" in m:
+        w("recompiles: %d beyond first compile (obs recompiles for the "
+          "per-event diffs)" % m["recompile_count"])
+    if "straggler_samples" in m:
+        w("stragglers: %d samples, max skew %.1f%%"
+          % (m["straggler_samples"], 100.0 * m["straggler_max_skew"]))
+    if "peak_mem_bytes" in m:
+        w("peak device memory: %.1f MiB" % (m["peak_mem_bytes"] / 2**20))
+    if "health" in m:
+        w("health: " + "  ".join("%s=%d" % kv
+                                 for kv in sorted(m["health"].items())))
+
+
+def render_recompiles(events, out=None):
+    """Every compile_attr event; True iff any same-signature recompile
+    (jit-cache thrash) is present — the --check failure condition."""
+    from .compile import format_diff
+    out = out or sys.stdout
+    w = lambda s="": out.write(s + "\n")
+    rows = recompile_rows(events)
+    if not rows:
+        w("no compile_attr events (run with obs_compile=true)")
+        return False
+    w("%-14s %4s %5s  %s" % ("entry", "n", "sig#", "what changed"))
+    thrash = False
+    for r in rows:
+        why = "; ".join(format_diff(d) for d in r["diff"]) \
+            or "first compile"
+        flops = (r["cost"] or {}).get("flops")
+        if flops is not None:
+            why += "  [%.3g flops]" % flops
+        w("%-14s %4d %5d  %s" % (r["entry"], r["n_compiles"],
+                                 r["sig_compiles"], why))
+        if r["sig_compiles"] > 1:
+            thrash = True
+    n = recompile_count(events)
+    w("total: %d compile(s) beyond first per entry" % n)
+    if thrash:
+        w("THRASH: an entry recompiled a signature it had already "
+          "compiled")
+    return thrash
+
+
+def render_stragglers(events, out=None):
+    out = out or sys.stdout
+    w = lambda s="": out.write(s + "\n")
+    rows = straggler_rows(events)
+    if not rows:
+        w("no straggler events (run with obs_straggler_every=N on a "
+          "multi-device mesh)")
+        return
+    w("%6s %7s %8s  %s" % ("iter", "skew", "slowest", "per-device "
+                           "wait_s"))
+    for e in rows:
+        waits = "  ".join("%s:%.4f" % (d["id"], d["wait_s"])
+                          for d in e.get("devices", []))
+        w("%6d %6.1f%% %8s  %s" % (e["it"], 100.0 * e.get("skew", 0.0),
+                                   e.get("slowest", "?"), waits))
+    run_end = next((e for e in events if e.get("ev") == "run_end"), None)
+    summ = (run_end or {}).get("stragglers")
+    if summ:
+        w("summary: %d samples, max skew %.1f%% at iter %s, slowest "
+          "counts %s" % (summ.get("samples", 0),
+                         100.0 * summ.get("max_skew", 0.0),
+                         summ.get("max_skew_it", "?"),
+                         summ.get("slowest_counts", {})))
+
+
+_DIFF_KEYS = ("iters", "iters_per_sec", "total_s", "compile_s",
+              "recompile_count", "peak_mem_bytes", "straggler_max_skew")
+
+
+def render_diff(a_events, b_events, out=None):
+    out = out or sys.stdout
+    w = lambda s="": out.write(s + "\n")
+    ma, mb = timeline_metrics(a_events), timeline_metrics(b_events)
+    w("%-18s %14s %14s %10s" % ("metric", "A", "B", "delta"))
+    for key in _DIFF_KEYS:
+        if key not in ma and key not in mb:
+            continue
+        va, vb = ma.get(key), mb.get(key)
+        if va is None or vb is None:
+            w("%-18s %14s %14s %10s"
+              % (key, "-" if va is None else "%.6g" % va,
+                 "-" if vb is None else "%.6g" % vb, "n/a"))
+            continue
+        if va:
+            delta = "%+.1f%%" % (100.0 * (vb - va) / va)
+        else:
+            delta = "+0%" if vb == va else "new"
+        w("%-18s %14.6g %14.6g %10s" % (key, va, vb, delta))
+    for side, m in (("A", ma), ("B", mb)):
+        if m.get("health"):
+            w("health %s: %s" % (side, "  ".join(
+                "%s=%d" % kv for kv in sorted(m["health"].items()))))
+
+
+def export_chrome_trace(events, out_path):
+    """Reconstruct a Chrome trace.json from phase-timer laps.
+
+    Each ``iter`` record carries its end wall-clock ``t`` and fenced
+    duration ``time_s``; the per-phase laps are re-laid end to end from
+    the iteration start (the order the phases ran — dicts preserve the
+    emission order).  Point events (compiles, health, stragglers) land
+    as instants on their own track."""
+    by_run = runs(events)
+    trace = []
+    for pid, (run, evs) in enumerate(by_run.items()):
+        t0 = min(e["t"] for e in evs)
+        trace.append({"ph": "M", "pid": pid, "name": "process_name",
+                      "args": {"name": "run %s" % run}})
+        for tid, tname in ((0, "iterations"), (1, "phases"),
+                           (2, "events")):
+            trace.append({"ph": "M", "pid": pid, "tid": tid,
+                          "name": "thread_name",
+                          "args": {"name": tname}})
+        for e in evs:
+            ev = e.get("ev")
+            if ev == "iter":
+                start = e["t"] - e["time_s"]
+                trace.append({"ph": "X", "pid": pid, "tid": 0,
+                              "name": "iter %d" % e["it"],
+                              "ts": (start - t0) * 1e6,
+                              "dur": e["time_s"] * 1e6,
+                              "args": {"fenced": e.get("fenced")}})
+                cur = start
+                for phase, dur in e.get("phases", {}).items():
+                    trace.append({"ph": "X", "pid": pid, "tid": 1,
+                                  "name": phase,
+                                  "ts": (cur - t0) * 1e6,
+                                  "dur": dur * 1e6,
+                                  "args": {"it": e["it"]}})
+                    cur += dur
+            elif ev in ("compile", "compile_attr", "health", "straggler",
+                        "trace_window"):
+                name = {"compile": "compile:%s",
+                        "compile_attr": "recompile:%s"}.get(ev)
+                label = (name % e.get("entry") if name
+                         else (("health:%s" % e.get("check")) if
+                               ev == "health" else ev))
+                args = {k: v for k, v in e.items()
+                        if k not in ("t", "run") and
+                        isinstance(v, (int, float, str, bool))}
+                trace.append({"ph": "i", "s": "p", "pid": pid, "tid": 2,
+                              "name": label, "ts": (e["t"] - t0) * 1e6,
+                              "args": args})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return len(trace)
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu obs",
+        description="query obs JSONL timelines (docs/Observability.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, hlp in (("summary", "headline metrics of the last run"),
+                      ("recompiles", "compile_attr events + diffs"),
+                      ("stragglers", "per-device arrival skew samples")):
+        p = sub.add_parser(name, help=hlp)
+        p.add_argument("timeline")
+        if name == "recompiles":
+            p.add_argument("--check", action="store_true",
+                           help="exit 1 on same-signature recompiles "
+                                "(jit-cache thrash) — the CI gate")
+    p = sub.add_parser("diff", help="two timelines side by side")
+    p.add_argument("baseline")
+    p.add_argument("candidate")
+    p = sub.add_parser("trace", help="export Chrome trace.json from "
+                                     "phase laps")
+    p.add_argument("timeline")
+    p.add_argument("-o", "--out", default="trace.json")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == "diff":
+            a = last_run(load_timeline(args.baseline))
+            b = last_run(load_timeline(args.candidate))
+        else:
+            events = last_run(load_timeline(args.timeline))
+    except (OSError, ValueError) as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.cmd == "summary":
+        render_summary(events)
+    elif args.cmd == "recompiles":
+        thrash = render_recompiles(events)
+        if args.check and thrash:
+            return 1
+    elif args.cmd == "stragglers":
+        render_stragglers(events)
+    elif args.cmd == "diff":
+        render_diff(a, b)
+    elif args.cmd == "trace":
+        n = export_chrome_trace(events, args.out)
+        print("wrote %d trace events -> %s (load in ui.perfetto.dev)"
+              % (n, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

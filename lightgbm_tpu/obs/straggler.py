@@ -1,0 +1,152 @@
+"""Distributed straggler profiling: per-device arrival-time skew.
+
+Under the distributed learners every tree's histogram psum is a
+barrier: the collective finishes when the *slowest* shard arrives, so
+one lagging device serializes the whole mesh — the per-device load
+imbalance arxiv 1809.04559 measures as the dominant multi-GPU cost.
+The host cannot see inside the jitted program, but it can time when
+each shard's output *becomes readable*: fetching the per-shard pieces
+of a row-sharded artifact one by one (``jax.Array.addressable_shards``
++ a tiny ``device_get`` each) turns shard completion order into host
+wall-clock.
+
+``StragglerProfiler`` samples this every ``obs_straggler_every``
+iterations (sampled, because each sample is a fence and costs the async
+pipeline).  Per sample it records the marginal wait per device — the
+time that device kept the host blocked beyond the shards already done —
+and derives
+
+    ``skew = (max_wait - median_wait) / total_wait``
+
+the fraction of the sample spent waiting on the single slowest device
+beyond the typical one.  Each sample lands in the timeline as a
+schema-v3 ``straggler`` event; skew above ``obs_straggler_warn_skew``
+is routed through the PR-2 health channel (a ``health`` event with
+``check="straggler_skew"`` + the monitors' warn counter); ``run_end``
+carries the rolling summary with the slowest-device attribution
+(which device was slowest, how often).
+
+Single-device values (serial learner, CPU without a forced mesh) have
+nothing to compare — the sampler counts the skip and stays silent,
+so the config can be left on unconditionally.
+"""
+from __future__ import annotations
+
+import time
+
+from ..utils.log import Log
+
+
+def _sharded_leaf(value):
+    """First leaf of ``value`` with >1 addressable shards, or None."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(value):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            shards = leaf.addressable_shards
+        except Exception:
+            continue
+        if len(shards) > 1:
+            return leaf, shards
+    return None, None
+
+
+def _axis_of(leaf):
+    """The mesh axis name(s) the leaf is partitioned over, best-effort."""
+    try:
+        spec = getattr(leaf.sharding, "spec", None)
+        if spec is None:
+            return ""
+        return ",".join(str(a) for a in spec if a is not None)
+    except Exception:
+        return ""
+
+
+class StragglerProfiler:
+    """Rolling straggler state driven by the observer's
+    ``straggler_sample`` hook (every ``obs_straggler_every`` iters)."""
+
+    def __init__(self, every=0, warn_skew=0.5, registry=None):
+        if registry is None:
+            from .metrics import REGISTRY
+            registry = REGISTRY
+        self._registry = registry
+        self.every = max(0, int(every))
+        self.warn_skew = float(warn_skew)
+        self.samples = 0
+        self.skipped_single = 0
+        self.warned = 0
+        self.max_skew = 0.0
+        self.max_skew_it = -1
+        self.slowest_counts = {}      # device id -> times it was slowest
+
+    def due(self, it):
+        return self.every > 0 and it % self.every == 0
+
+    def sample(self, obs, it, value):
+        """Time per-shard arrival of ``value``'s first sharded leaf and
+        emit a ``straggler`` event.  A full fence: only call on the
+        sampling cadence."""
+        import numpy as np
+
+        leaf, shards = _sharded_leaf(value)
+        if leaf is None:
+            self.skipped_single += 1
+            return
+        waits = []
+        prev = time.perf_counter()
+        for sh in shards:
+            # a tiny device_get per shard: returns when THIS shard's
+            # producer is done, so the marginal wait is attributable
+            np.asarray(sh.data)
+            now = time.perf_counter()
+            waits.append((int(sh.device.id), now - prev))
+            prev = now
+        total = sum(w for _, w in waits)
+        ordered = sorted(w for _, w in waits)
+        median = ordered[len(ordered) // 2]
+        slowest_id, max_wait = max(waits, key=lambda p: p[1])
+        skew = (max_wait - median) / total if total > 0 else 0.0
+        self.samples += 1
+        self.slowest_counts[slowest_id] = \
+            self.slowest_counts.get(slowest_id, 0) + 1
+        if skew > self.max_skew:
+            self.max_skew, self.max_skew_it = skew, it
+        axis = _axis_of(leaf)
+        obs.event("straggler", it=it, axis=axis,
+                  devices=[{"id": d, "wait_s": round(w, 6)}
+                           for d, w in waits],
+                  skew=round(skew, 4), slowest=slowest_id,
+                  total_s=round(total, 6))
+        self._registry.counter(
+            "lgbm_straggler_samples_total",
+            "per-shard arrival-skew samples taken").inc()
+        self._registry.gauge(
+            "lgbm_straggler_max_skew",
+            "worst observed per-device arrival skew this run").set(
+                self.max_skew)
+        if skew > self.warn_skew:
+            self.warned += 1
+            detail = {"skew": round(skew, 4), "slowest": slowest_id,
+                      "axis": axis, "threshold": self.warn_skew}
+            # route through the PR-2 health channel: same event shape,
+            # same warn counter, so one reader sees every anomaly
+            obs.event("health", check="straggler_skew", status="warn",
+                      it=it, detail=detail)
+            if obs.health is not None:
+                obs.health.counts["warn"] += 1
+            Log.warning("obs: straggler skew %.0f%% at iter %d (device "
+                        "%d slowest on axis %r)", 100.0 * skew, it,
+                        slowest_id, axis or "?")
+
+    def summary(self):
+        """Folded into run_end: rolling attribution of who straggled."""
+        return {"every": self.every, "samples": self.samples,
+                "skipped_single_device": self.skipped_single,
+                "warned": self.warned, "warn_skew": self.warn_skew,
+                "max_skew": round(self.max_skew, 4),
+                "max_skew_it": self.max_skew_it,
+                "slowest_counts": {str(k): v for k, v in
+                                   sorted(self.slowest_counts.items())}}

@@ -753,9 +753,12 @@ class SerialTreeLearner:
             hess = jnp.concatenate(
                 [hess, jnp.zeros(self._row_pad, self.dtype)])
         obs = self._obs
+        args = (self.X, grad, hess, row_mult, feature_mask)
+        obs.entry_args("tree_grow", self._grow, args,
+                       names=("X", "grad", "hess", "row_mult",
+                              "feature_mask"))
         t0 = obs.entry_start()
-        tree, leaf_id = self._grow(self.X, grad, hess, row_mult,
-                                   feature_mask)
+        tree, leaf_id = self._grow(*args)
         obs.entry_end("tree_grow", t0, (tree, leaf_id))
         if self._row_pad:
             leaf_id = leaf_id[:self.train_data.num_data]

@@ -13,9 +13,32 @@ the reference running MPI single-process in CI, .travis.yml:45-52).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, List
 
 import numpy as np
+
+
+def _observe_collective(op, dt, nbytes=0):
+    """Record one host-level collective in the metrics registry
+    (obs/metrics.py).  The gather is a barrier — its wall time is set by
+    the slowest rank, so this histogram is the host-side counterpart of
+    the device-side straggler sampler (obs/straggler.py).  Best-effort:
+    instrumentation must never fail a collective."""
+    try:
+        from ..obs.metrics import REGISTRY
+        REGISTRY.histogram(
+            "lgbm_host_collective_seconds",
+            "wall time of host-level collectives (distributed loading "
+            "and config sync); barrier time = slowest rank",
+            labels={"op": str(op)}).observe(dt)
+        if nbytes:
+            REGISTRY.counter(
+                "lgbm_host_collective_bytes_total",
+                "payload bytes moved by host-level collectives",
+                labels={"op": str(op)}).inc(nbytes)
+    except Exception:
+        pass
 
 
 class HostComm:
@@ -79,6 +102,7 @@ def run_ranks(size: int, fn):
             return size
 
         def allgather_obj(self, obj):
+            t0 = time.perf_counter()
             i = self._round
             self._round += 1
             deposits.setdefault(i, [None] * size)[self._rank] = obj
@@ -88,6 +112,7 @@ def run_ranks(size: int, fn):
             barrier.wait(timeout=_BARRIER_TIMEOUT)
             out = list(deposits[i])
             barrier.wait(timeout=_BARRIER_TIMEOUT)   # keep rounds separate
+            _observe_collective("allgather_obj", time.perf_counter() - t0)
             return out
 
     def runner(r):
@@ -134,6 +159,7 @@ class JaxProcessComm(HostComm):
     def allgather_obj(self, obj: Any) -> List[Any]:
         import jax
         from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
         payload = json.dumps(obj).encode()
         n = np.zeros(1, np.int32) + len(payload)
         sizes = multihost_utils.process_allgather(n).reshape(-1)
@@ -144,6 +170,8 @@ class JaxProcessComm(HostComm):
         for r in range(self._size):
             raw = bytes(np.asarray(gathered[r][:int(sizes[r])]))
             out.append(json.loads(raw.decode()))
+        _observe_collective("allgather_obj", time.perf_counter() - t0,
+                            nbytes=int(sizes.sum()))
         return out
 
 

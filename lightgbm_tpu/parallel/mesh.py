@@ -368,6 +368,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self._Xt is not None:
             args += (self._Xt,)
         obs = self._obs
+        obs.entry_args("tree_grow", self._grow, args,
+                       names=("X", "grad", "hess", "row_mult",
+                              "feature_mask", "Xt")[:len(args)])
         t0 = obs.entry_start()
         tree, leaf_id = self._grow(*args)
         obs.entry_end("tree_grow", t0, (tree, leaf_id))

@@ -103,6 +103,10 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_metrics_file": "obs_metrics_path",
     "obs_metrics": "obs_metrics_path",
     "obs_metrics_freq": "obs_metrics_every",
+    "obs_compile_attr": "obs_compile",
+    "obs_recompile_attr": "obs_compile",
+    "obs_straggler_freq": "obs_straggler_every",
+    "obs_straggler_skew": "obs_straggler_warn_skew",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -151,6 +155,7 @@ PARAMETER_SET = {
     "obs_health", "obs_health_every", "obs_health_divergence",
     "obs_health_plateau", "obs_health_mem_frac",
     "obs_metrics_path", "obs_metrics_every",
+    "obs_compile", "obs_straggler_every", "obs_straggler_warn_skew",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -491,6 +496,20 @@ class Config:
         # embed a registry snapshot (`metrics` event) in the timeline
         # every N iterations (0 = only the final snapshot at run end)
         "obs_metrics_every": ("int", 0),
+        # XLA compile-cache introspection (lightgbm_tpu/obs/compile.py):
+        # track per-entry compile counts and the arg shape/dtype/donation
+        # signature of every recompile, diffed so the `compile_attr`
+        # event names the changed axis, plus cost_analysis() /
+        # memory_analysis() estimates.  Turns the observer on.
+        "obs_compile": ("bool", False),
+        # sample per-shard arrival skew of the distributed learners
+        # every N iterations (obs/straggler.py; each sample fences, so
+        # keep the cadence coarse).  0 = off.  No-op on single device.
+        "obs_straggler_every": ("int", 0),
+        # warn (through the obs_health channel) when a straggler
+        # sample's skew — (max-median)/total per-shard wait — exceeds
+        # this fraction
+        "obs_straggler_warn_skew": ("float", 0.5),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

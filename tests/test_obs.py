@@ -70,8 +70,12 @@ def test_emitted_timeline_is_schema_valid(tmp_path):
 
 
 def test_validate_event_rejects_bad_records():
+    # unknown event types pass by default (forward compatibility: older
+    # readers must accept newer-schema timelines) but fail under strict
+    unknown = {"ev": "nope", "t": 0, "run": "x"}
+    assert validate_event(unknown) is unknown
     with pytest.raises(ValueError):
-        validate_event({"ev": "nope", "t": 0, "run": "x"})
+        validate_event(unknown, strict=True)
     with pytest.raises(ValueError):
         validate_event({"ev": "iter", "t": 0, "run": "x"})   # missing keys
     with pytest.raises(ValueError):

@@ -1,0 +1,415 @@
+"""Recompile attribution, straggler profiling, and the obs query CLI.
+
+Covers the PR-3 attribution layer on the CPU backend: signature
+diffing down to the offending axis, CompileTracker driven through the
+observer hooks on a real jitted function, end-to-end attribution when
+a learner is rebuilt against shape-unstable input, the straggler
+profiler on both a single device (no-op) and the virtual 8-device mesh,
+the ``python -m lightgbm_tpu obs`` subcommands, bench_compare's
+``recompile_count`` gate, and forward/backward schema compatibility.
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import RunObserver, read_events
+from lightgbm_tpu.obs.compile import (CompileTracker, arg_signature,
+                                      diff_signatures, format_diff,
+                                      render_signature)
+from lightgbm_tpu.obs.straggler import StragglerProfiler
+from lightgbm_tpu.obs import query
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.ops.learner import SerialTreeLearner
+from lightgbm_tpu.utils.config import Config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _obs(path, **kw):
+    kw.setdefault("timing", "off")
+    return RunObserver(events_path=str(path), **kw)
+
+
+def _drive(obs, name, fn, *args, names=None):
+    """One observed entry call, the way the learners drive the hooks."""
+    obs.entry_args(name, fn, args, names=names)
+    t0 = obs.entry_start()
+    out = fn(*args)
+    obs.entry_end(name, t0, out)
+    return out
+
+
+# ----------------------------------------------------------- signatures
+
+def test_signature_render_and_axis_diff():
+    x = jnp.zeros((8, 4), jnp.float32)
+    g = jnp.zeros(8, jnp.float32)
+    sig = arg_signature((x, g), names=("x", "grad"), donate=(1,))
+    assert render_signature(sig) == {"x": "float32[8,4]",
+                                     "grad": "float32[8] (donated)"}
+    sig2 = arg_signature((jnp.zeros((8, 5), jnp.float32), g),
+                         names=("x", "grad"), donate=(1,))
+    diff = diff_signatures(sig, sig2)
+    assert diff == [{"arg": "x", "field": "shape", "axis": 1,
+                     "before": 4, "after": 5}]
+    assert format_diff(diff[0]) == "x.shape[1]: 4 -> 5"
+    # first compile has nothing to diff against
+    assert diff_signatures(None, sig) == []
+
+
+def test_diff_catches_dtype_rank_and_donation():
+    a = arg_signature((jnp.zeros((4, 2), jnp.float32),), names=("x",))
+    fields = {d["field"] for d in diff_signatures(
+        a, arg_signature((jnp.zeros((4, 2), jnp.int32),), names=("x",)))}
+    assert fields == {"dtype"}
+    fields = {d["field"] for d in diff_signatures(
+        a, arg_signature((jnp.zeros(4, jnp.float32),), names=("x",)))}
+    assert fields == {"rank"}
+    fields = {d["field"] for d in diff_signatures(
+        a, arg_signature((jnp.zeros((4, 2), jnp.float32),), names=("x",),
+                         donate=(0,)))}
+    assert fields == {"donated"}
+
+
+# ------------------------------------------------------- CompileTracker
+
+def test_tracker_attributes_recompile_to_changed_axis(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, compile_attr=True)
+    fn = jax.jit(lambda x: (x * 2.0).sum(axis=0))
+    with obs:
+        _drive(obs, "f", fn, jnp.ones((8, 4), jnp.float32), names=("x",))
+        _drive(obs, "f", fn, jnp.ones((8, 4), jnp.float32), names=("x",))
+        _drive(obs, "f", fn, jnp.ones((8, 5), jnp.float32), names=("x",))
+    attr = [e for e in read_events(path) if e["ev"] == "compile_attr"]
+    assert len(attr) == 2          # the repeat call hit the jit cache
+    first, second = attr
+    assert first["n_compiles"] == 1 and first["diff"] == []
+    assert first["sig"] == {"x": "float32[8,4]"}
+    assert second["n_compiles"] == 2 and second["sig_compiles"] == 1
+    assert {"arg": "x", "field": "shape", "axis": 1,
+            "before": 4, "after": 5} in second["diff"]
+    # AOT analysis works on the CPU backend: both estimates present
+    assert second["cost"]["flops"] > 0
+    assert second["memory"]["argument_bytes"] > 0
+    assert "output_bytes" in second["memory"]
+    # run_end folds the per-entry summary
+    end = [e for e in read_events(path) if e["ev"] == "run_end"][-1]
+    assert end["compile_attr"]["f"] == {"calls": 3, "compiles": 2,
+                                        "signatures": 2,
+                                        "max_sig_compiles": 1}
+
+
+def test_tracker_flags_program_rebuild_as_thrash(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, compile_attr=True)
+    x = jnp.ones((16,), jnp.float32)
+    with obs:
+        # rebuilding the jitted program per call recompiles the SAME
+        # signature — the thrash case the CI gate exists for
+        _drive(obs, "f", jax.jit(lambda v: v + 1.0), x, names=("x",))
+        _drive(obs, "f", jax.jit(lambda v: v + 1.0), x, names=("x",))
+    attr = [e for e in read_events(path) if e["ev"] == "compile_attr"]
+    assert attr[-1]["sig_compiles"] == 2
+    assert attr[-1]["diff"][0]["field"] == "program"
+    events = query.last_run(query.load_timeline(str(path)))
+    assert query.render_recompiles(events, out=open(os.devnull, "w")) \
+        is True
+
+
+def test_learner_rebuild_names_the_row_axis(tmp_path):
+    """Shape-unstable input end to end: two learners whose padded row
+    counts differ, under one observer; the second compile_attr must
+    name axis 0 of the gradient arrays AND the program rebuild.  The
+    row sizes straddle a padding bucket so the device shapes really
+    change (the learner pads rows, so 256 vs 512 would both land on the
+    same padded size and diff only as a program rebuild)."""
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, compile_attr=True)
+    cfg = Config({"num_leaves": 7, "min_data_in_leaf": 5, "verbose": -1})
+    rng = np.random.default_rng(0)
+    with obs:
+        for n in (600, 1500):
+            X = rng.normal(size=(n, 4))
+            y = (X[:, 0] > 0).astype(np.float64)
+            td = TrainingData.from_matrix(X, label=y, config=cfg)
+            lr = SerialTreeLearner(cfg, td)
+            lr.set_observer(obs)
+            g = rng.normal(size=n).astype(np.float32)
+            h = np.full(n, 0.25, np.float32)
+            lr.train(g, h)
+    attr = [e for e in read_events(path) if e["ev"] == "compile_attr"]
+    assert len(attr) == 2 and attr[0]["entry"] == "tree_grow"
+    diff = attr[-1]["diff"]
+    assert diff[0]["field"] == "program"
+    rows = [d for d in diff if d.get("arg") == "grad"
+            and d.get("field") == "shape"]
+    assert rows and rows[0]["axis"] == 0
+    assert rows[0]["before"] < rows[0]["after"]
+    assert attr[-1]["sig"]["grad"] == "float32[%d]" % rows[0]["after"]
+
+
+def test_end_to_end_train_emits_compile_attr(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "obs_events_path": str(path), "obs_compile": True},
+              lgb.Dataset(X, label=y), num_boost_round=2)
+    events = query.last_run(query.load_timeline(str(path)))
+    rows = query.recompile_rows(events)
+    assert rows and rows[0]["entry"] == "tree_grow"
+    # a shape-stable run compiles once and never again
+    assert query.recompile_count(events) == 0
+    end = events[-1]
+    assert end["ev"] == "run_end"
+    assert end["compile_attr"]["tree_grow"]["compiles"] == 1
+    assert end["compile_attr"]["tree_grow"]["calls"] == 2
+
+
+# ------------------------------------------------------------ straggler
+
+def test_straggler_noop_on_single_device(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, straggler_every=1)
+    with obs:
+        obs.straggler_sample(0, jnp.ones(32, jnp.float32))
+        obs.straggler_sample(1, {"leaf": jnp.ones(8)})
+    events = read_events(path)
+    assert not [e for e in events if e["ev"] == "straggler"]
+    summ = events[-1]["stragglers"]
+    assert summ["samples"] == 0
+    assert summ["skipped_single_device"] == 2
+
+
+def test_straggler_cadence_gates_sampling(tmp_path):
+    obs = _obs(tmp_path / "ev.jsonl", straggler_every=3)
+    prof = obs._straggler
+    assert [it for it in range(10) if prof.due(it)] == [0, 3, 6, 9]
+    assert StragglerProfiler(every=0).due(0) is False
+
+
+def _sharded(n=64):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    return jax.device_put(np.arange(n, dtype=np.float32),
+                          NamedSharding(mesh, P("data")))
+
+
+def test_straggler_sample_on_virtual_mesh(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, straggler_every=1)
+    with obs:
+        obs.straggler_sample(0, _sharded())
+    events = read_events(path)
+    samples = [e for e in events if e["ev"] == "straggler"]
+    assert len(samples) == 1
+    s = samples[0]
+    assert len(s["devices"]) == 8
+    ids = {d["id"] for d in s["devices"]}
+    assert s["slowest"] in ids and len(ids) == 8
+    assert 0.0 <= s["skew"] <= 1.0
+    assert s["axis"] == "data"
+    summ = events[-1]["stragglers"]
+    assert summ["samples"] == 1
+    assert summ["slowest_counts"] == {str(s["slowest"]): 1}
+
+
+def test_straggler_warn_routes_through_health_channel(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    # warn_skew=-1: every sample warns, deterministically
+    obs = _obs(path, straggler_every=1, straggler_warn_skew=-1.0)
+    with obs:
+        obs.straggler_sample(0, _sharded())
+    events = read_events(path)
+    warns = [e for e in events if e["ev"] == "health"]
+    assert len(warns) == 1
+    assert warns[0]["check"] == "straggler_skew"
+    assert warns[0]["status"] == "warn"
+    assert warns[0]["detail"]["slowest"] == \
+        [e for e in events if e["ev"] == "straggler"][0]["slowest"]
+    assert events[-1]["stragglers"]["warned"] == 1
+
+
+# ------------------------------------------------------------ query CLI
+
+@pytest.fixture(scope="module")
+def timeline(tmp_path_factory):
+    """One instrumented 3-iteration training run, queried many ways."""
+    path = tmp_path_factory.mktemp("obs") / "run.jsonl"
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(400, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "obs_events_path": str(path), "obs_compile": True,
+               "obs_straggler_every": 1},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    return str(path)
+
+
+def test_cli_summary(timeline, capsys):
+    assert query.main(["summary", timeline]) == 0
+    out = capsys.readouterr().out
+    assert "status ok" in out
+    assert "iters 3" in out
+    assert "recompiles: 0 beyond first compile" in out
+    assert "entry tree_grow" in out
+
+
+def test_cli_recompiles_clean(timeline, capsys):
+    assert query.main(["recompiles", timeline, "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "tree_grow" in out
+    assert "first compile" in out
+    assert "THRASH" not in out
+
+
+def test_cli_stragglers_and_diff(timeline, capsys):
+    # serial CPU learner -> single device -> no straggler events
+    assert query.main(["stragglers", timeline]) == 0
+    assert "no straggler events" in capsys.readouterr().out
+    assert query.main(["diff", timeline, timeline]) == 0
+    out = capsys.readouterr().out
+    assert "recompile_count" in out
+    for line in out.splitlines():
+        if line.startswith(("iters", "compile_s", "recompile_count")):
+            assert line.rstrip().endswith(("+0.0%", "+0%"))
+
+
+def test_cli_trace_export(timeline, tmp_path, capsys):
+    out_path = str(tmp_path / "trace.json")
+    assert query.main(["trace", timeline, "-o", out_path]) == 0
+    with open(out_path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"iter 0", "iter 1", "iter 2"} <= names
+    assert {"boost", "grow", "partition"} <= names
+    assert any(n.startswith("recompile:tree_grow") for n in names)
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans)
+
+
+def test_cli_missing_file_is_usage_error(tmp_path, capsys):
+    assert query.main(["summary", str(tmp_path / "nope.jsonl")]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def _synth_timeline(path, run, n_compiles, extra_sig_compiles=1):
+    """A minimal schema-valid timeline with a controllable recompile
+    count (the shape bench_compare and --check gate on)."""
+    recs = [{"ev": "run_header", "t": 0.0, "run": run, "schema": 3,
+             "backend": "cpu", "devices": [{"id": 0}], "params": {},
+             "context": {}, "timing": "phase"}]
+    t = 1.0
+    for i in range(2):
+        recs.append({"ev": "iter", "t": t, "run": run, "it": i,
+                     "time_s": 0.5, "phases": {"grow": 0.4}, "fenced": True})
+        t += 1.0
+    for n in range(1, n_compiles + 1):
+        recs.append({"ev": "compile_attr", "t": t, "run": run,
+                     "entry": "tree_grow", "n_compiles": n,
+                     "sig": {"x": "float32[%d,4]" % (8 * n)},
+                     "sig_compiles": extra_sig_compiles if n > 1 else 1,
+                     "diff": [] if n == 1 else
+                     [{"arg": "x", "field": "shape", "axis": 0,
+                       "before": 8 * (n - 1), "after": 8 * n}]})
+    recs.append({"ev": "run_end", "t": t + 1, "run": run, "iters": 2,
+                 "phase_totals": {"grow": 0.8}, "entries": {},
+                 "status": "ok"})
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def test_cli_check_exits_1_on_thrash(tmp_path, capsys):
+    p = _synth_timeline(tmp_path / "thrash.jsonl", "r1", n_compiles=2,
+                        extra_sig_compiles=3)
+    assert query.main(["recompiles", p, "--check"]) == 1
+    assert "THRASH" in capsys.readouterr().out
+    # without --check the same timeline only reports
+    assert query.main(["recompiles", p]) == 0
+    capsys.readouterr()
+
+
+# --------------------------------------------------------- perf gating
+
+def _bench_compare(argv):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+    return bench_compare.main(argv)
+
+
+def test_bench_compare_gates_on_recompiles(tmp_path, capsys):
+    clean = _synth_timeline(tmp_path / "clean.jsonl", "a", n_compiles=1)
+    churn = _synth_timeline(tmp_path / "churn.jsonl", "b", n_compiles=3)
+    assert _bench_compare([clean, clean]) == 0
+    capsys.readouterr()
+    assert _bench_compare([clean, churn]) == 1
+    out = capsys.readouterr().out
+    assert "recompile_count" in out and "REGRESSED" in out
+    # a regressed candidate used as its own baseline still passes
+    assert _bench_compare([churn, churn]) == 0
+    capsys.readouterr()
+
+
+def test_obs_diff_shows_regression(tmp_path, capsys):
+    clean = _synth_timeline(tmp_path / "clean.jsonl", "a", n_compiles=1)
+    churn = _synth_timeline(tmp_path / "churn.jsonl", "b", n_compiles=3)
+    assert query.main(["diff", clean, churn]) == 0
+    out = capsys.readouterr().out
+    row = [ln for ln in out.splitlines()
+           if ln.startswith("recompile_count")]
+    assert row and row[0].rstrip().endswith("new")
+
+
+# -------------------------------------------------------------- compat
+
+def test_schema_v2_timeline_still_loads(tmp_path):
+    p = tmp_path / "v2.jsonl"
+    recs = [{"ev": "run_header", "t": 0.0, "run": "old", "schema": 2,
+             "backend": "cpu", "devices": [{"id": 0}], "params": {},
+             "context": {}, "timing": "phase"},
+            {"ev": "iter", "t": 1.0, "run": "old", "it": 0, "time_s": 0.5,
+             "phases": {"grow": 0.4}, "fenced": True},
+            {"ev": "run_end", "t": 2.0, "run": "old", "iters": 1,
+             "phase_totals": {"grow": 0.4}, "entries": {},
+             "status": "ok"}]
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    events = query.last_run(query.load_timeline(str(p)))
+    m = query.timeline_metrics(events)
+    assert m["schema"] == 2 and m["iters"] == 1
+    # pre-v3 runs simply have no recompile data, not a zero
+    assert "recompile_count" not in m
+    assert query.recompile_count(events) == 0
+
+
+def test_unknown_future_event_passes_loader(tmp_path):
+    p = tmp_path / "v9.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"ev": "quantum_flux", "t": 0.0, "run": "f",
+                            "qubits": 3}) + "\n")
+    events = query.load_timeline(str(p))
+    assert events[0]["ev"] == "quantum_flux"
+
+
+def test_config_aliases_resolve():
+    cfg = Config({"obs_compile_attr": "true", "obs_straggler_freq": "4",
+                  "obs_straggler_skew": "0.3", "verbose": -1})
+    assert cfg.obs_compile is True
+    assert cfg.obs_straggler_every == 4
+    assert cfg.obs_straggler_warn_skew == 0.3

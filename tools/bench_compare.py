@@ -10,8 +10,9 @@ Accepted artifact kinds (auto-detected per file):
 
 * an obs JSONL timeline (``obs_events_path`` / ``bench.py --dry``) —
   iters/sec over the LAST run's fenced iter records, compile seconds
-  from the run_end entry summaries (or compile events), peak device
-  memory from memory snapshots (absent on CPU);
+  from the run_end entry summaries (or compile events), recompile
+  count from ``compile_attr`` events (``obs_compile=true``), peak
+  device memory from memory snapshots (absent on CPU);
 * a ``BENCH_r*.json`` lineage record — ``parsed.value`` with
   ``parsed.unit`` of iters/sec;
 * a bare bench JSON line — ``{"metric": ..., "value": ...}`` as printed
@@ -24,7 +25,8 @@ artifact are reported and skipped; no overlap at all is a usage error.
 
 Usage:
     python tools/bench_compare.py BASELINE CANDIDATE \
-        [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] [--json]
+        [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
+        [--tol-recompile 0] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -32,12 +34,22 @@ import argparse
 import json
 import sys
 
+EXIT_CODES = """\
+exit codes:
+  0  pass — every comparable metric within tolerance
+  1  regression — at least one metric beyond its tolerance
+  2  load/usage error — unreadable artifact or no comparable metrics\
+"""
+
 # metric -> (direction, default tolerance); direction +1 = higher is
 # better, -1 = lower is better
 METRICS = {
     "iters_per_sec": (+1, 0.08),
     "compile_s": (-1, 0.25),
     "peak_mem_bytes": (-1, 0.10),
+    # compiles beyond the first per entry (compile_attr events);
+    # tolerance 0: ANY new recompile vs a clean baseline is a failure
+    "recompile_count": (-1, 0.0),
 }
 
 
@@ -68,6 +80,15 @@ def _from_timeline(events):
                                    d.get("bytes_in_use", 0)))
     if peak:
         out["peak_mem_bytes"] = peak
+    # compiles beyond the first, per entry (obs_compile=true runs only —
+    # a timeline without compile_attr events just skips the metric)
+    attr = [e for e in events if e.get("ev") == "compile_attr"]
+    if attr:
+        worst = {}
+        for e in attr:
+            worst[e.get("entry")] = max(worst.get(e.get("entry"), 0),
+                                        int(e.get("n_compiles", 1)))
+        out["recompile_count"] = sum(n - 1 for n in worst.values())
     return out
 
 
@@ -134,10 +155,13 @@ def compare(base, cand, tols):
         if name not in base or name not in cand:
             continue
         b, c = float(base[name]), float(cand[name])
-        tol = tols[name]
+        tol = tols.get(name, METRICS[name][1])
         if b == 0:
-            delta = 0.0
-            regressed = False
+            # a zero baseline breaks the relative form; any nonzero
+            # lower-is-better candidate (e.g. recompile_count 0 -> 2)
+            # exceeds every relative tolerance and must regress
+            delta = 0.0 if c == 0 else float("inf")
+            regressed = direction < 0 and c > 0
         else:
             delta = (c - b) / b
             regressed = (direction > 0 and c < b * (1.0 - tol)) or \
@@ -149,7 +173,9 @@ def compare(base, cand, tols):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="compare two bench/timeline artifacts; nonzero exit "
-                    "on perf regression beyond tolerance")
+                    "on perf regression beyond tolerance",
+        epilog=EXIT_CODES,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("baseline")
     ap.add_argument("candidate")
     ap.add_argument("--tol-ips", type=float, default=METRICS[
@@ -158,11 +184,16 @@ def main(argv=None):
         "compile_s"][1], help="compile-time relative tolerance")
     ap.add_argument("--tol-mem", type=float, default=METRICS[
         "peak_mem_bytes"][1], help="peak-memory relative tolerance")
+    ap.add_argument("--tol-recompile", type=float, default=METRICS[
+        "recompile_count"][1],
+        help="recompile-count relative tolerance (0 = any new "
+             "recompile vs a clean baseline fails)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
     tols = {"iters_per_sec": args.tol_ips, "compile_s": args.tol_compile,
-            "peak_mem_bytes": args.tol_mem}
+            "peak_mem_bytes": args.tol_mem,
+            "recompile_count": args.tol_recompile}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

@@ -147,6 +147,18 @@ NOTES = {
     "obs_metrics_every": "embed a metrics snapshot event into the "
                          "timeline every N iterations (0 = final "
                          "snapshot only when obs_metrics_path is set)",
+    "obs_compile": "track the XLA compile cache per jitted entry: every "
+                   "(re)compile emits a compile_attr event with the arg "
+                   "shape/dtype/donation signature, a diff naming the "
+                   "changed axis, and cost/memory analysis estimates",
+    "obs_straggler_every": "sample per-shard arrival skew of the "
+                           "distributed learners every N iterations "
+                           "(each sample fences; 0 = off; no-op on a "
+                           "single device)",
+    "obs_straggler_warn_skew": "warn through the obs_health channel "
+                               "when a straggler sample's skew — "
+                               "(max-median)/total per-shard wait — "
+                               "exceeds this fraction",
 }
 
 GROUPS = [
@@ -193,7 +205,8 @@ GROUPS = [
         "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
         "obs_health", "obs_health_every", "obs_health_divergence",
         "obs_health_plateau", "obs_health_mem_frac", "obs_metrics_path",
-        "obs_metrics_every"]),
+        "obs_metrics_every", "obs_compile", "obs_straggler_every",
+        "obs_straggler_warn_skew"]),
 ]
 
 

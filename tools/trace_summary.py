@@ -43,19 +43,23 @@ def load_events(trace_dir):
 
 
 def summarize_jsonl(path, csv=False, out=None):
-    """Summarize the LAST run recorded in an obs event timeline."""
+    """Summarize the LAST run recorded in an obs event timeline.
+
+    Ingest rides lightgbm_tpu/obs/query.py — the same loader the
+    ``python -m lightgbm_tpu obs`` CLI uses, so the two consumers can
+    never disagree about run grouping or validation."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from lightgbm_tpu.obs import read_events
+    from lightgbm_tpu.obs import query
     out = out if out is not None else sys.stdout
-    events = read_events(path)
+    events = query.last_run(query.load_timeline(path))
     if not events:
         raise SystemExit("no events in %s" % path)
     run = events[-1]["run"]
-    events = [e for e in events if e["run"] == run]
     header = next((e for e in events if e["ev"] == "run_header"), None)
     iters = [e for e in events if e["ev"] == "iter"]
     compiles = [e for e in events if e["ev"] == "compile"]
+    recompiles = query.recompile_rows(events)
     run_end = next((e for e in events if e["ev"] == "run_end"), None)
 
     phase_totals = collections.Counter()
@@ -80,6 +84,9 @@ def summarize_jsonl(path, csv=False, out=None):
             w("entry_execute,%s,%.6f,%.6f,%d,steady_state\n"
               % (name, st["exec_total_s"], st["exec_mean_s"],
                  st["exec_n"]))
+        for r in recompiles:
+            w("compile_attr,%s,,,%d,sig_compiles=%d\n"
+              % (r["entry"], r["n_compiles"], r["sig_compiles"]))
         hc = collections.Counter((e["check"], e["status"]) for e in health)
         for (check, status), n in sorted(hc.items()):
             w("health,%s,,,%d,%s\n" % (check, n, status))
@@ -122,6 +129,26 @@ def summarize_jsonl(path, csv=False, out=None):
             w("  %-12s %12.3f %12.3f %12.4f %8d"
               % (name, st["first_s"], st.get("compile_est_s", 0.0),
                  st["exec_mean_s"], st["exec_n"]))
+
+    if recompiles:
+        from lightgbm_tpu.obs.compile import format_diff
+        w("\n== recompiles (compile_attr, obs_compile=true) ==")
+        w("  %-12s %4s %5s  %s" % ("entry", "n", "sig#", "what changed"))
+        for r in recompiles:
+            why = ("; ".join(format_diff(d) for d in r["diff"])
+                   or "first compile")
+            w("  %-12s %4d %5d  %s" % (r["entry"], r["n_compiles"],
+                                       r["sig_compiles"], why))
+
+    stragglers = query.straggler_rows(events)
+    if stragglers:
+        w("\n== straggler samples ==")
+        for e in stragglers[:10]:
+            w("  it %-5d skew %5.1f%%  slowest device %s"
+              % (e["it"], 100.0 * e.get("skew", 0.0),
+                 e.get("slowest", "?")))
+        if len(stragglers) > 10:
+            w("  ... %d more samples" % (len(stragglers) - 10))
 
     peaks = {}
     for e in events:
