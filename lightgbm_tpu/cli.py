@@ -115,9 +115,16 @@ def run_train(cfg: Config) -> None:
         # failed run still leaves a readable timeline (status=aborted)
         booster._obs.close(status="ok" if finished else "aborted")
     if cfg.obs_events_path:
-        Log.info("Telemetry timeline -> %s (query with `python -m "
-                 "lightgbm_tpu obs summary %s`)", cfg.obs_events_path,
-                 cfg.obs_events_path)
+        obs = booster._obs
+        ep = (str(getattr(obs, "events_path", "") or "")
+              or cfg.obs_events_path)
+        if getattr(obs, "world_size", 1) > 1:
+            Log.info("Telemetry timeline shard (rank %d/%d) -> %s "
+                     "(cross-rank view: `python -m lightgbm_tpu obs "
+                     "merge %s`)", obs.rank, obs.world_size, ep, ep)
+        else:
+            Log.info("Telemetry timeline -> %s (query with `python -m "
+                     "lightgbm_tpu obs summary %s`)", ep, ep)
     if cfg.obs_metrics_path:
         Log.info("Metrics export -> %s", cfg.obs_metrics_path)
     booster.save_model_to_file(cfg.output_model)

@@ -129,10 +129,19 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     for dataset_name, eval_name, score, _ in evaluation_result_list or []:
         booster.best_score[dataset_name][eval_name] = score
     booster.finalize_telemetry()
-    ep = str(params.get("obs_events_path", "") or "")
+    obs = getattr(booster, "_obs", None)
+    ep = (str(getattr(obs, "events_path", "") or "")
+          if obs is not None and obs.enabled
+          else str(params.get("obs_events_path", "") or ""))
     if ep:
-        Log.debug("obs: timeline %s (query: python -m lightgbm_tpu obs "
-                  "summary %s)", ep, ep)
+        if obs is not None and getattr(obs, "world_size", 1) > 1:
+            # per-rank shard — the cross-rank view needs the merge step
+            Log.debug("obs: rank %d/%d timeline shard %s (cross-rank "
+                      "view: python -m lightgbm_tpu obs merge %s)",
+                      obs.rank, obs.world_size, ep, ep)
+        else:
+            Log.debug("obs: timeline %s (query: python -m lightgbm_tpu "
+                      "obs summary %s)", ep, ep)
     return booster
 
 

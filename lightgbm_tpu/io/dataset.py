@@ -82,6 +82,9 @@ class TrainingData:
             "Column_%d" % i for i in range(self.num_total_features)]
 
         cats = set(int(c) for c in categorical_feature)
+        # remember the comm: the Booster shards its observer's timeline
+        # per rank (obs/events.py) off the training data's comm
+        self._comm = comm if (comm is not None and comm.size > 1) else None
         if reference is not None:
             self._align_with(reference, data)
         elif comm is not None and comm.size > 1:

@@ -122,7 +122,8 @@ class GBDT:
         prev = getattr(self, "_obs", NULL_OBSERVER)
         if prev.enabled:
             prev.close()
-        self._obs = observer_from_config(config)
+        self._obs = observer_from_config(
+            config, comm=getattr(self.train_data, "_comm", None))
         self._metrics = None
         if self._obs.enabled:
             devices = [{"id": int(d.id), "platform": str(d.platform),

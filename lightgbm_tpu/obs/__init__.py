@@ -27,24 +27,41 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   distributed learners (``obs_straggler_every`` /
   ``obs_straggler_warn_skew``);
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
-  obs summary|recompiles|stragglers|diff|trace``.
+  obs summary|recompiles|stragglers|merge|diff|trace``;
+* ``merge``   — cross-rank merge of per-rank timeline shards: barrier
+  skew per host collective (aligned on ``seq``), per-rank phase
+  comparison, slowest-rank attribution, and a merged critical-path
+  timeline trace_summary/bench_compare ingest directly;
+* ``watchdog`` — hang watchdog + flight recorder: no progress within
+  ``obs_watchdog_secs`` (or SIGTERM, or an ``obs_health=fatal`` abort)
+  dumps the event ring buffer, all thread stacks, device memory and a
+  metrics snapshot to ``<events_path>.flight.json``.
+
+Distributed runs are rank-native (schema 4): each rank writes its own
+timeline shard (``obs_events_path`` + ``.r{rank}``), every event
+carries the rank, and the run header records rank/world_size/
+coordinator.
 
 Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_memory_every``, ``obs_trace_iters``, ``obs_trace_dir``,
-``obs_flush_every``, ``obs_health*``, ``obs_metrics*``,
-``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``.
+``obs_flush_every``, ``obs_fsync``, ``obs_health*``, ``obs_metrics*``,
+``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``,
+``obs_watchdog_secs``, ``obs_flight_events``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
 
 from .events import (NULL_OBSERVER, SCHEMA_VERSION, EventWriter,
-                     NullObserver, RunObserver, read_events, validate_event)
+                     NullObserver, RingBuffer, RunObserver,
+                     current_observer, read_events, resolve_rank_path,
+                     validate_event)
 from .health import HealthMonitors
 from .metrics import REGISTRY, MetricsRegistry
 from ..utils.log import Log
 
 __all__ = ["NULL_OBSERVER", "NullObserver", "RunObserver", "EventWriter",
-           "SCHEMA_VERSION", "read_events", "validate_event",
+           "RingBuffer", "SCHEMA_VERSION", "read_events", "validate_event",
+           "current_observer", "resolve_rank_path",
            "observer_from_config", "HealthMonitors", "MetricsRegistry",
            "REGISTRY"]
 
@@ -52,9 +69,15 @@ _TIMING_MODES = ("auto", "phase", "iter", "off")
 _HEALTH_MODES = ("off", "warn", "fatal")
 
 
-def observer_from_config(config):
+def observer_from_config(config, comm=None):
     """RunObserver from the ``obs_*`` config params, or NULL_OBSERVER when
     nothing is enabled — the disabled path must cost one attribute check.
+
+    ``comm``: optional parallel.comm.HostComm — the observer then shards
+    its timeline for that rank (``obs_events_path`` auto-suffixes
+    ``.r{rank}``) and stamps every event with it.  Without a comm the
+    rank is resolved from the thread's rank context (run_ranks) or
+    jax.distributed, falling back to a rank-0 single-process run.
 
     ``obs_timing`` semantics: 'phase' fences every phase boundary with
     ``jax.block_until_ready`` (device-accurate per-phase times; breaks the
@@ -106,6 +129,11 @@ def observer_from_config(config):
             plateau=int(getattr(config, "obs_health_plateau", 0) or 0),
             mem_frac=float(getattr(config, "obs_health_mem_frac",
                                    0.9) or 0.0))
+    rank = world_size = None
+    coordinator = ""
+    if comm is not None:
+        rank, world_size = int(comm.rank), int(comm.size)
+        coordinator = str(getattr(comm, "coordinator", "") or "")
     return RunObserver(events_path=events_path, timing=timing,
                        memory_every=memory_every, trace_iters=trace_iters,
                        trace_dir=trace_dir,
@@ -117,4 +145,13 @@ def observer_from_config(config):
                        straggler_every=straggler_every,
                        straggler_warn_skew=float(
                            getattr(config, "obs_straggler_warn_skew",
-                                   0.5) or 0.5))
+                                   0.5) or 0.5),
+                       rank=rank, world_size=world_size,
+                       coordinator=coordinator,
+                       fsync=bool(getattr(config, "obs_fsync", False)),
+                       watchdog_secs=float(
+                           getattr(config, "obs_watchdog_secs", 0.0)
+                           or 0.0),
+                       flight_events=int(
+                           getattr(config, "obs_flight_events", 256)
+                           or 256))

@@ -18,10 +18,19 @@ straggler      it, devices, skew (schema 3; obs/straggler.py — per-shard
 memory         it, devices
 trace_window   action, dir, it
 collectives    learner (plus learner-specific topology/byte estimates)
+host_collective op, seq, dur_s (schema 4; parallel/comm.py — one host
+               barrier/allgather with its monotonic sequence number)
 health         check, status, it (schema 2; obs/health.py monitors)
 metrics        it, scrape (schema 2; obs/metrics.py registry snapshot)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
+
+Schema 4 makes the timeline rank-native: the run header carries
+``rank``/``world_size``/``coordinator``, every event of a multi-rank
+run carries ``rank``, ``iter`` events carry a monotonic ``seq``, and
+``obs_events_path`` becomes a per-rank template (``{rank}`` placeholder,
+or an automatic ``.r{rank}`` suffix when world_size > 1) — see
+obs/merge.py for the cross-rank view.
 
 ``RunObserver`` is the facade the training loop drives; ``NULL_OBSERVER``
 is the shared disabled instance — every method is a no-op and the hot
@@ -37,8 +46,10 @@ whenever the interpreter gets to unwind.
 from __future__ import annotations
 
 import atexit
+import collections
 import json
 import os
+import threading
 import time
 
 from .memory import MemorySampler, device_memory_stats
@@ -46,10 +57,10 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 3
-# schema 1 (no health/metrics) and 2 (no compile_attr/straggler)
-# timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3)
+SCHEMA_VERSION = 4
+# schema 1 (no health/metrics), 2 (no compile_attr/straggler) and
+# 3 (rank-less, no host_collective) timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -62,10 +73,127 @@ _REQUIRED = {
     "memory": ("it", "devices"),
     "trace_window": ("action", "dir", "it"),
     "collectives": ("learner",),
+    # schema 4 (parallel/comm.py): one host-level collective with its
+    # monotonic per-rank sequence number — obs/merge.py aligns shards
+    # on (op, seq) to measure barrier skew
+    "host_collective": ("op", "seq", "dur_s"),
     "health": ("check", "status", "it"),
     "metrics": ("it", "scrape"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
+
+
+def resolve_rank_path(path, rank, world_size):
+    """Per-rank shard path from the ``obs_events_path`` template.
+
+    An explicit ``{rank}`` placeholder is always substituted; otherwise
+    multi-rank runs (world_size > 1) auto-suffix ``.r{rank}`` so N ranks
+    never interleave writes into one file, and single-process runs keep
+    the configured path byte-for-byte."""
+    path = str(path or "")
+    if not path:
+        return path
+    if "{rank}" in path:
+        return path.replace("{rank}", str(int(rank)))
+    if int(world_size or 1) > 1:
+        return "%s.r%d" % (path, int(rank))
+    return path
+
+
+class RingBuffer:
+    """Fixed-capacity ring of the most recent events — the flight
+    recorder's view of "what was the run doing right before it died".
+    Appends are lock-free (GIL-atomic deque ops) because the watchdog
+    thread snapshots while rank threads append."""
+
+    def __init__(self, capacity=256):
+        self.capacity = max(1, int(capacity))
+        self._buf = collections.deque(maxlen=self.capacity)
+        self.dropped = 0
+
+    def append(self, rec):
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1
+        self._buf.append(rec)
+
+    def snapshot(self):
+        """List copy, oldest first."""
+        return list(self._buf)
+
+    def __len__(self):
+        return len(self._buf)
+
+
+# -- live-observer registry ----------------------------------------------
+# parallel/comm.py emits host_collective events and arms the hang
+# watchdog around barriers without holding an observer reference: each
+# RunObserver registers itself per creating thread (run_ranks simulates
+# one rank per thread, so thread-locality IS rank-locality) plus a
+# process-global list for main-thread lookups and SIGTERM flight dumps.
+_TLS = threading.local()
+_LIVE = []
+_LIVE_LOCK = threading.Lock()
+
+
+def _register_observer(obs):
+    _TLS.observer = obs
+    with _LIVE_LOCK:
+        _LIVE.append(obs)
+
+
+def _unregister_observer(obs):
+    if getattr(_TLS, "observer", None) is obs:
+        _TLS.observer = None
+    with _LIVE_LOCK:
+        try:
+            _LIVE.remove(obs)
+        except ValueError:
+            pass
+
+
+def current_observer():
+    """The live observer of the calling thread (its simulated rank), or —
+    only from the main thread, where cross-wiring is impossible — the
+    most recent live observer."""
+    obs = getattr(_TLS, "observer", None)
+    if obs is not None and not obs._closed:
+        return obs
+    if threading.current_thread() is threading.main_thread():
+        with _LIVE_LOCK:
+            for cand in reversed(_LIVE):
+                if not cand._closed:
+                    return cand
+    return None
+
+
+def live_observers():
+    """All live observers (flight-dump fan-out on SIGTERM)."""
+    with _LIVE_LOCK:
+        return [o for o in _LIVE if not o._closed]
+
+
+def _default_rank_info():
+    """Process rank for an observer that wasn't told one explicitly:
+    the comm rank context if a HostComm is active on this thread
+    (simulated run_ranks ranks included), else jax.distributed's
+    process index/count, else rank 0 of 1."""
+    try:
+        from ..parallel.comm import rank_context
+        info = rank_context()
+        if info is not None:
+            return info
+    except Exception:
+        pass
+    try:
+        import jax
+        n = int(jax.process_count())
+        if n > 1:
+            return {"rank": int(jax.process_index()), "world_size": n,
+                    "coordinator": os.environ.get(
+                        "JAX_COORDINATOR_ADDRESS", "")}
+    except Exception:
+        pass
+    return {"rank": 0, "world_size": 1, "coordinator": ""}
 
 
 def validate_event(rec, strict=False):
@@ -111,37 +239,58 @@ def read_events(path, validate=True):
 
 class EventWriter:
     """Append-mode JSONL writer, flushed every ``flush_every`` events
-    (and on close) so a killed run still leaves a readable timeline."""
+    (and on close) so a killed run still leaves a readable timeline.
 
-    def __init__(self, path, flush_every=16):
+    ``run_end`` is flushed UNCONDITIONALLY the moment it is emitted,
+    whatever ``flush_every`` says — a crash right after finalize must
+    not lose the one record every reader keys on.  ``fsync=True``
+    (``obs_fsync``) additionally fsyncs on those barriers, surviving
+    OS-level death (OOM-kill, node power loss), not just interpreter
+    death.  Emits are lock-serialized: the hang watchdog writes its
+    final events from its own thread."""
+
+    def __init__(self, path, flush_every=16, fsync=False):
         self.path = str(path)
         self.flush_every = max(1, int(flush_every))
+        self.fsync = bool(fsync)
         self._f = None
         self._pending = 0
+        self._lock = threading.Lock()
 
     def emit(self, rec):
-        if self._f is None:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._f = open(self.path, "a")
-        self._f.write(json.dumps(rec, default=str) + "\n")
-        self._pending += 1
-        if self._pending >= self.flush_every:
-            self._f.flush()
-            self._pending = 0
+        with self._lock:
+            if self._f is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write(json.dumps(rec, default=str) + "\n")
+            self._pending += 1
+            if self._pending >= self.flush_every \
+                    or rec.get("ev") == "run_end":
+                self._flush_locked(sync=(self.fsync and
+                                         rec.get("ev") == "run_end"))
+
+    def _flush_locked(self, sync=False):
+        self._f.flush()
+        self._pending = 0
+        if sync:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
 
     def flush(self):
-        if self._f is not None:
-            self._f.flush()
-            self._pending = 0
+        with self._lock:
+            if self._f is not None:
+                self._flush_locked(sync=self.fsync)
 
     def close(self):
-        if self._f is not None:
-            self._f.flush()
-            self._f.close()
-            self._f = None
-            self._pending = 0
+        with self._lock:
+            if self._f is not None:
+                self._flush_locked(sync=self.fsync)
+                self._f.close()
+                self._f = None
 
     def __enter__(self):
         return self
@@ -159,8 +308,20 @@ class NullObserver:
     enabled = False
     timeline = ()
     health = None
+    rank = 0
+    world_size = 1
+    _closed = False
 
     def event(self, ev, **fields):
+        pass
+
+    def watchdog_arm(self, label):
+        pass
+
+    def watchdog_disarm(self):
+        pass
+
+    def flight(self, reason, extra=None):
         pass
 
     def iter_begin(self, it):
@@ -216,13 +377,30 @@ class RunObserver(NullObserver):
                  trace_iters="", trace_dir="", flush_every=16,
                  health=None, metrics_every=0, metrics_path="",
                  compile_attr=False, straggler_every=0,
-                 straggler_warn_skew=0.5):
+                 straggler_warn_skew=0.5, rank=None, world_size=None,
+                 coordinator="", fsync=False, watchdog_secs=0.0,
+                 flight_events=256):
         from . import metrics as metrics_mod
+        if rank is None or world_size is None:
+            info = _default_rank_info()
+            rank = info["rank"] if rank is None else rank
+            world_size = (info["world_size"] if world_size is None
+                          else world_size)
+            coordinator = coordinator or info.get("coordinator", "")
+        self.rank = int(rank)
+        self.world_size = max(1, int(world_size))
+        self.coordinator = str(coordinator or "")
         self.run_id = os.urandom(4).hex()
         self.timing = timing
         self.timeline = []
-        self._writer = (EventWriter(events_path, flush_every)
-                        if events_path else None)
+        self.events_path = resolve_rank_path(events_path, self.rank,
+                                             self.world_size)
+        self._writer = (EventWriter(self.events_path, flush_every,
+                                    fsync=fsync)
+                        if self.events_path else None)
+        self._ring = RingBuffer(flight_events)
+        self._flight_dumped = False
+        self._seq = 0
         self._clock = PhaseClock(fence_laps=(timing == "phase"))
         self._entries = EntryTimers()
         self._memory = MemorySampler(memory_every)
@@ -249,14 +427,23 @@ class RunObserver(NullObserver):
             "(fencing per obs_timing)")
         self._m_iters = self._registry.counter(
             "lgbm_train_iterations_total", "boosting iterations completed")
+        self._watchdog = None
+        if float(watchdog_secs or 0.0) > 0.0:
+            from .watchdog import Watchdog
+            self._watchdog = Watchdog(self, float(watchdog_secs))
+            self._watchdog.start()
         # a killed run must still end in a flushed, parseable timeline
         atexit.register(self._finalize_at_exit)
+        _register_observer(self)
 
     # -- raw emission --------------------------------------------------
     def event(self, ev, **fields):
         rec = {"ev": ev, "t": time.time(), "run": self.run_id}
+        if self.world_size > 1:
+            rec["rank"] = self.rank
         rec.update(fields)
         self.timeline.append(rec)
+        self._ring.append(rec)
         if self._writer is not None:
             self._writer.emit(rec)
         return rec
@@ -264,10 +451,14 @@ class RunObserver(NullObserver):
     def run_header(self, backend, devices, params, context):
         self.event("run_header", schema=SCHEMA_VERSION, backend=backend,
                    devices=devices, params=params, context=context,
-                   timing=self.timing)
+                   timing=self.timing, rank=self.rank,
+                   world_size=self.world_size,
+                   coordinator=self.coordinator)
 
     # -- per-iteration hooks ------------------------------------------
     def iter_begin(self, it):
+        if self._watchdog is not None:
+            self._watchdog.arm("iter %d" % it)
         self._trace.maybe_start(it, self)
         self._clock.begin()
 
@@ -278,11 +469,15 @@ class RunObserver(NullObserver):
         if self.timing in ("phase", "iter"):
             fence(value)
         total, phases = self._clock.end()
+        seq = self._seq
+        self._seq += 1
         self._iters += 1
         self._m_iter_s.observe(total)
         self._m_iters.inc()
-        self.event("iter", it=it, time_s=total, phases=phases,
+        self.event("iter", it=it, seq=seq, time_s=total, phases=phases,
                    fenced=(self.timing in ("phase", "iter")), **fields)
+        if self._watchdog is not None:
+            self._watchdog.pet("iter %d done" % it)
         devices = self._memory.maybe(it)
         if devices is not None:
             self.event("memory", it=it, devices=devices)
@@ -329,6 +524,37 @@ class RunObserver(NullObserver):
         if self._straggler is not None and self._straggler.due(it):
             self._straggler.sample(self, it, value)
 
+    # -- hang forensics (obs/watchdog.py) ------------------------------
+    def watchdog_arm(self, label):
+        """Arm the hang watchdog around a blocking region (a host
+        collective): no progress for obs_watchdog_secs from now dumps a
+        flight record naming ``label``."""
+        if self._watchdog is not None:
+            self._watchdog.arm(label)
+
+    def watchdog_disarm(self):
+        """The blocking region completed; fall back to the per-iteration
+        progress deadline."""
+        if self._watchdog is not None:
+            self._watchdog.pet("idle")
+
+    def flight(self, reason, extra=None):
+        """Dump a flight record now (watchdog expiry, SIGTERM,
+        obs_health=fatal).  Works with the watchdog off — the ring
+        buffer is always live.  Returns the path written, or None when
+        there is no events path to anchor the dump next to."""
+        from .watchdog import dump_flight_record
+        return dump_flight_record(self, reason, extra=extra)
+
+    @property
+    def flight_path(self):
+        if self._writer is None:
+            return ""
+        return self._writer.path + ".flight.json"
+
+    def ring_snapshot(self):
+        return self._ring.snapshot()
+
     # -- misc ----------------------------------------------------------
     def memory_snapshot(self, it):
         self.event("memory", it=it, devices=device_memory_stats())
@@ -340,7 +566,19 @@ class RunObserver(NullObserver):
     def close(self, status="ok"):
         if self._closed:
             return
+        if status == "aborted" and not self._flight_dumped:
+            # the flight record is the black box: write it BEFORE the
+            # run_end path below can fail.  A record the watchdog (or
+            # obs_health=fatal) already dumped names the actual hang —
+            # don't overwrite it with this generic one.
+            try:
+                self.flight("run aborted")
+            except Exception:
+                pass
         self._closed = True
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        _unregister_observer(self)
         try:
             atexit.unregister(self._finalize_at_exit)
         except Exception:

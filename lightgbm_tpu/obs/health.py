@@ -201,6 +201,11 @@ class HealthMonitors:
                 fatal.append(check)
         if fatal:
             obs.flush()           # the timeline must survive the raise
+            try:                  # black box for the abort (obs/watchdog.py)
+                obs.flight("obs_health=fatal: %s" % "/".join(fatal),
+                           extra={"it": it, "checks": fatal})
+            except Exception:
+                pass
             Log.fatal("obs_health=fatal: %s tripped at iteration %d "
                       "(timeline has the health event)"
                       % ("/".join(fatal), it))

@@ -13,6 +13,11 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   (jit-cache thrash), the CI gate;
 * ``stragglers RUN.jsonl``    — per-sample skew + slowest-device
   attribution from ``straggler`` events;
+* ``merge RUN.jsonl [-o M.jsonl]`` — discover the per-rank shards of a
+  distributed run (``RUN.jsonl.r0`` ...), align them on iteration /
+  collective ``seq`` (obs/merge.py), print per-collective barrier skew,
+  per-rank phase comparison and the slowest-rank table, and optionally
+  write the merged critical-path timeline;
 * ``diff A.jsonl B.jsonl``    — headline metrics of two timelines side
   by side with deltas (informational; ``tools/bench_compare.py`` is the
   tolerance-gated verdict);
@@ -94,6 +99,11 @@ def timeline_metrics(events):
         out["schema"] = header.get("schema")
         out["devices"] = len(header.get("devices", []))
         out["timing"] = header.get("timing")
+        if "world_size" in header:
+            out["rank"] = header.get("rank")
+            out["world_size"] = header.get("world_size")
+        if header.get("merged"):
+            out["merged"] = True
     iters = [e for e in events if e.get("ev") == "iter"]
     total = sum(e["time_s"] for e in iters)
     out["iters"] = len(iters)
@@ -137,10 +147,18 @@ def timeline_metrics(events):
         out["straggler_samples"] = len(stragglers)
         out["straggler_max_skew"] = max(e.get("skew", 0.0)
                                         for e in stragglers)
+    colls = [e for e in events if e.get("ev") == "host_collective"]
+    if colls:
+        out["host_collectives"] = len(colls)
+        skews = [e["skew_s"] for e in colls if "skew_s" in e]
+        if skews:
+            out["barrier_skew_max_s"] = max(skews)
     if run_end:
         out["status"] = run_end.get("status", "ok")
         if "stragglers" in run_end:
             out["stragglers"] = run_end["stragglers"]
+        if "rank_report" in run_end:
+            out["rank_report"] = run_end["rank_report"]
     return out
 
 
@@ -157,6 +175,14 @@ def render_summary(events, out=None):
       % (m.get("run"), m.get("schema", "?"), m.get("backend", "?"),
          m.get("devices", "?"), m.get("timing", "?"),
          m.get("status", "?")))
+    if m.get("merged"):
+        w("merged view of a %s-rank run" % m.get("world_size", "?"))
+    elif m.get("world_size", 1) and int(m.get("world_size", 1) or 1) > 1:
+        w("rank %s of %s  (coordinator-sharded timeline)"
+          % (m.get("rank", "?"), m.get("world_size")))
+        w("WARNING: this is ONE shard of a multi-rank run — totals and "
+          "skew below are rank-local; run `python -m lightgbm_tpu obs "
+          "merge <shard>` for the cross-rank view")
     ips = (" (%.3f iters/sec)" % m["iters_per_sec"]
            if "iters_per_sec" in m else "")
     w("iters %d  total %.3f s%s" % (m["iters"], m["total_s"], ips))
@@ -176,11 +202,20 @@ def render_summary(events, out=None):
     if "straggler_samples" in m:
         w("stragglers: %d samples, max skew %.1f%%"
           % (m["straggler_samples"], 100.0 * m["straggler_max_skew"]))
+    if "host_collectives" in m:
+        skew = ("  max barrier skew %.6f s" % m["barrier_skew_max_s"]
+                if "barrier_skew_max_s" in m else "")
+        w("host collectives: %d%s" % (m["host_collectives"], skew))
     if "peak_mem_bytes" in m:
         w("peak device memory: %.1f MiB" % (m["peak_mem_bytes"] / 2**20))
     if "health" in m:
         w("health: " + "  ".join("%s=%d" % kv
                                  for kv in sorted(m["health"].items())))
+    rr = m.get("rank_report")
+    if rr:
+        from .merge import render_report
+        w()
+        render_report(rr, out)
 
 
 def render_recompiles(events, out=None):
@@ -239,7 +274,8 @@ def render_stragglers(events, out=None):
 
 
 _DIFF_KEYS = ("iters", "iters_per_sec", "total_s", "compile_s",
-              "recompile_count", "peak_mem_bytes", "straggler_max_skew")
+              "recompile_count", "peak_mem_bytes", "straggler_max_skew",
+              "barrier_skew_max_s")
 
 
 def render_diff(a_events, b_events, out=None):
@@ -304,12 +340,16 @@ def export_chrome_trace(events, out_path):
                                   "args": {"it": e["it"]}})
                     cur += dur
             elif ev in ("compile", "compile_attr", "health", "straggler",
-                        "trace_window"):
+                        "trace_window", "host_collective"):
                 name = {"compile": "compile:%s",
                         "compile_attr": "recompile:%s"}.get(ev)
-                label = (name % e.get("entry") if name
-                         else (("health:%s" % e.get("check")) if
-                               ev == "health" else ev))
+                if ev == "host_collective":
+                    label = "collective:%s seq=%s" % (e.get("op"),
+                                                      e.get("seq"))
+                else:
+                    label = (name % e.get("entry") if name
+                             else (("health:%s" % e.get("check")) if
+                                   ev == "health" else ev))
                 args = {k: v for k, v in e.items()
                         if k not in ("t", "run") and
                         isinstance(v, (int, float, str, bool))}
@@ -337,6 +377,13 @@ def main(argv=None):
             p.add_argument("--check", action="store_true",
                            help="exit 1 on same-signature recompiles "
                                 "(jit-cache thrash) — the CI gate")
+    p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
+                                     "of per-rank shards")
+    p.add_argument("shards", nargs="+",
+                   help="shard files, or one base/shard path to "
+                        "auto-discover .r* siblings")
+    p.add_argument("-o", "--out", default="",
+                   help="write the merged critical-path timeline here")
     p = sub.add_parser("diff", help="two timelines side by side")
     p.add_argument("baseline")
     p.add_argument("candidate")
@@ -347,6 +394,19 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     try:
+        if args.cmd == "merge":
+            from .merge import (discover_shards, load_shards,
+                                merge_shards, render_report,
+                                write_merged)
+            paths = (list(args.shards) if len(args.shards) > 1
+                     else discover_shards(args.shards[0]))
+            shards = load_shards(paths)
+            merged, report = merge_shards(shards)
+            render_report(report)
+            if args.out:
+                n = write_merged(merged, args.out)
+                print("\nwrote %d merged events -> %s" % (n, args.out))
+            return 0
         if args.cmd == "diff":
             a = last_run(load_timeline(args.baseline))
             b = last_run(load_timeline(args.candidate))

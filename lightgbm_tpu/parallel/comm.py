@@ -13,18 +13,59 @@ the reference running MPI single-process in CI, .travis.yml:45-52).
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 from typing import Any, List
 
 import numpy as np
 
+# -- rank context ---------------------------------------------------------
+# Who am I, for observability: every HostComm registers its
+# (rank, world_size, coordinator) here so the run observer
+# (obs/events.py) can shard its timeline per rank without every caller
+# hand-plumbing the comm through.  Thread-local because run_ranks
+# simulates one rank per thread; the process-global slot serves the real
+# multi-host case (one JaxProcessComm per process, main thread).
+_RANK_TLS = threading.local()
+_RANK_GLOBAL = None
 
-def _observe_collective(op, dt, nbytes=0):
-    """Record one host-level collective in the metrics registry
-    (obs/metrics.py).  The gather is a barrier — its wall time is set by
-    the slowest rank, so this histogram is the host-side counterpart of
-    the device-side straggler sampler (obs/straggler.py).  Best-effort:
-    instrumentation must never fail a collective."""
+
+def set_rank_context(rank, world_size, coordinator=""):
+    global _RANK_GLOBAL
+    info = {"rank": int(rank), "world_size": int(world_size),
+            "coordinator": str(coordinator or "")}
+    _RANK_TLS.info = info
+    if threading.current_thread() is threading.main_thread():
+        _RANK_GLOBAL = info
+    return info
+
+
+def clear_rank_context():
+    global _RANK_GLOBAL
+    _RANK_TLS.info = None
+    if threading.current_thread() is threading.main_thread():
+        _RANK_GLOBAL = None
+
+
+def rank_context():
+    """{rank, world_size, coordinator} of the calling thread's comm, the
+    process's comm, or None when no HostComm has registered."""
+    info = getattr(_RANK_TLS, "info", None)
+    if info is not None:
+        return info
+    return _RANK_GLOBAL
+
+
+def _observe_collective(op, dt, nbytes=0, seq=None):
+    """Record one host-level collective: a metrics-registry histogram
+    (obs/metrics.py) plus — when a run observer is live on this thread —
+    a schema-4 ``host_collective`` timeline event carrying the monotonic
+    ``seq`` obs/merge.py aligns shards on.  The gather is a barrier: its
+    wall time is set by the slowest rank, so ``t_start`` (when THIS rank
+    arrived) is the per-rank arrival the cross-rank skew analysis
+    compares.  Best-effort: instrumentation must never fail a
+    collective."""
     try:
         from ..obs.metrics import REGISTRY
         REGISTRY.histogram(
@@ -39,6 +80,60 @@ def _observe_collective(op, dt, nbytes=0):
                 labels={"op": str(op)}).inc(nbytes)
     except Exception:
         pass
+    if seq is None:
+        return
+    try:
+        from ..obs.events import current_observer
+        obs = current_observer()
+        if obs is not None and obs.enabled:
+            obs.event("host_collective", op=str(op), seq=int(seq),
+                      dur_s=round(dt, 6), t_start=time.time() - dt,
+                      nbytes=int(nbytes))
+    except Exception:
+        pass
+
+
+class _CollectiveGuard:
+    """Arm the hang watchdog (obs/watchdog.py) around a blocking host
+    collective so a barrier that never returns dumps a flight record
+    naming the op and its seq.  No-op without a live observer."""
+
+    def __init__(self, op, seq):
+        self._obs = None
+        try:
+            from ..obs.events import current_observer
+            self._obs = current_observer()
+        except Exception:
+            pass
+        self.op, self.seq = op, seq
+
+    def __enter__(self):
+        if self._obs is not None:
+            self._obs.watchdog_arm("collective %s seq=%d"
+                                   % (self.op, self.seq))
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._obs is not None:
+            self._obs.watchdog_disarm()
+        return False
+
+
+class BarrierTimeoutError(threading.BrokenBarrierError):
+    """A simulated-rank collective timed out: names which ranks had
+    arrived at the barrier and which were missing, instead of the bare
+    threading.BrokenBarrierError that says nothing about who hung."""
+
+    def __init__(self, op, seq, timeout_s, arrived, size):
+        arrived = sorted(arrived)
+        missing = sorted(set(range(size)) - set(arrived))
+        self.op, self.seq = op, seq
+        self.arrived, self.missing = arrived, missing
+        super().__init__(
+            "host collective %s (seq %d) timed out after %.1fs: ranks "
+            "%s arrived at the barrier, ranks %s never did — a missing "
+            "rank hung, crashed, or skipped the collective"
+            % (op, seq, timeout_s, arrived, missing))
 
 
 class HostComm:
@@ -51,6 +146,11 @@ class HostComm:
     @property
     def size(self) -> int:
         raise NotImplementedError
+
+    @property
+    def coordinator(self) -> str:
+        """Coordinator address, for the run header ("" when local)."""
+        return ""
 
     def allgather_obj(self, obj: Any) -> List[Any]:
         """Gather one JSON-serializable object from every rank, in rank
@@ -74,24 +174,37 @@ class SingleProcessComm(HostComm):
         return [obj]
 
 
-def run_ranks(size: int, fn):
+DEFAULT_BARRIER_TIMEOUT = 120.0     # seconds; generous for CI boxes
+
+
+def run_ranks(size: int, fn, fault=None, barrier_timeout=None):
     """Drive `fn(comm)` for `size` simulated ranks on threads with a
     barrier at every collective — the test fixture the reference never had
     (SURVEY.md §4: it smoke-tested MPI single-process instead).  Returns
     the per-rank results in rank order; re-raises the first rank failure.
-    """
-    import threading
 
-    _BARRIER_TIMEOUT = 120.0     # seconds; generous for CI boxes
+    ``fault``: optional ``fault(rank, seq)`` hook invoked on every rank
+    right before it arrives at collective ``seq`` — the deterministic
+    fault-injection point the distributed-obs tests use to force a slow
+    rank (sleep) or a hang (sleep past ``barrier_timeout``, which then
+    raises BarrierTimeoutError naming the arrived vs missing ranks).
+    """
+    timeout_s = float(barrier_timeout if barrier_timeout is not None
+                      else DEFAULT_BARRIER_TIMEOUT)
     deposits = {}
+    arrivals = {}                      # seq -> set of ranks at the barrier
     results: List[Any] = [None] * size
     errors: List[Any] = [None] * size
+    aborted_by_error = threading.Event()
     barrier = threading.Barrier(size)
 
     class _ThreadComm(HostComm):
         def __init__(self, rank):
             self._rank = rank
             self._round = 0
+            # this thread IS rank `rank` from here on: observers created
+            # on it shard their timeline accordingly
+            set_rank_context(rank, size, coordinator="run_ranks")
 
         @property
         def rank(self):
@@ -101,37 +214,65 @@ def run_ranks(size: int, fn):
         def size(self):
             return size
 
+        @property
+        def coordinator(self):
+            return "run_ranks"
+
         def allgather_obj(self, obj):
             t0 = time.perf_counter()
             i = self._round
             self._round += 1
+            if fault is not None:
+                fault(self._rank, i)
             deposits.setdefault(i, [None] * size)[self._rank] = obj
+            arrivals.setdefault(i, set()).add(self._rank)
             # timeout -> BrokenBarrierError in every waiter, so a rank that
             # skips a collective (or crashes) fails the test loudly instead
             # of deadlocking join() forever
-            barrier.wait(timeout=_BARRIER_TIMEOUT)
-            out = list(deposits[i])
-            barrier.wait(timeout=_BARRIER_TIMEOUT)   # keep rounds separate
-            _observe_collective("allgather_obj", time.perf_counter() - t0)
+            try:
+                with _CollectiveGuard("allgather_obj", i):
+                    barrier.wait(timeout=timeout_s)
+                    out = list(deposits[i])
+                    barrier.wait(timeout=timeout_s)  # keep rounds separate
+            except threading.BrokenBarrierError:
+                if aborted_by_error.is_set():
+                    raise          # a peer failed; its error wins below
+                raise BarrierTimeoutError(
+                    "allgather_obj", i, timeout_s,
+                    arrivals.get(i, set()), size) from None
+            _observe_collective("allgather_obj", time.perf_counter() - t0,
+                                seq=i)
             return out
 
     def runner(r):
         try:
             results[r] = fn(_ThreadComm(r))
+        except threading.BrokenBarrierError as e:   # timeout/abort
+            errors[r] = e
         except Exception as e:           # surface after join
             errors[r] = e
+            aborted_by_error.set()
             barrier.abort()
+        finally:
+            clear_rank_context()
 
-    threads = [threading.Thread(target=runner, args=(r,)) for r in range(size)]
+    threads = [threading.Thread(target=runner, args=(r,),
+                                name="run_ranks-r%d" % r)
+               for r in range(size)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    import threading as _t
     real = [e for e in errors
-            if e is not None and not isinstance(e, _t.BrokenBarrierError)]
+            if e is not None
+            and not isinstance(e, threading.BrokenBarrierError)]
     if real:
         raise real[0]        # the rank that failed, not its stalled peers
+    # every survivor saw the same broken barrier; prefer the diagnosable
+    # timeout (who arrived / who was missing) over a bare abort echo
+    for e in errors:
+        if isinstance(e, BarrierTimeoutError):
+            raise e
     for e in errors:
         if e is not None:
             raise e
@@ -147,6 +288,10 @@ class JaxProcessComm(HostComm):
         import jax
         self._rank = jax.process_index()
         self._size = jax.process_count()
+        self._seq = 0
+        self._coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+        set_rank_context(self._rank, self._size,
+                         coordinator=self._coordinator)
 
     @property
     def rank(self) -> int:
@@ -156,22 +301,29 @@ class JaxProcessComm(HostComm):
     def size(self) -> int:
         return self._size
 
+    @property
+    def coordinator(self) -> str:
+        return self._coordinator
+
     def allgather_obj(self, obj: Any) -> List[Any]:
         import jax
         from jax.experimental import multihost_utils
         t0 = time.perf_counter()
+        seq = self._seq
+        self._seq += 1
         payload = json.dumps(obj).encode()
         n = np.zeros(1, np.int32) + len(payload)
-        sizes = multihost_utils.process_allgather(n).reshape(-1)
-        buf = np.zeros(int(sizes.max()), np.uint8)
-        buf[:len(payload)] = np.frombuffer(payload, np.uint8)
-        gathered = multihost_utils.process_allgather(buf)
+        with _CollectiveGuard("allgather_obj", seq):
+            sizes = multihost_utils.process_allgather(n).reshape(-1)
+            buf = np.zeros(int(sizes.max()), np.uint8)
+            buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+            gathered = multihost_utils.process_allgather(buf)
         out = []
         for r in range(self._size):
             raw = bytes(np.asarray(gathered[r][:int(sizes[r])]))
             out.append(json.loads(raw.decode()))
         _observe_collective("allgather_obj", time.perf_counter() - t0,
-                            nbytes=int(sizes.sum()))
+                            nbytes=int(sizes.sum()), seq=seq)
         return out
 
 

@@ -107,6 +107,9 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_recompile_attr": "obs_compile",
     "obs_straggler_freq": "obs_straggler_every",
     "obs_straggler_skew": "obs_straggler_warn_skew",
+    "obs_watchdog": "obs_watchdog_secs",
+    "obs_events_fsync": "obs_fsync",
+    "obs_ring_events": "obs_flight_events",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -156,6 +159,7 @@ PARAMETER_SET = {
     "obs_health_plateau", "obs_health_mem_frac",
     "obs_metrics_path", "obs_metrics_every",
     "obs_compile", "obs_straggler_every", "obs_straggler_warn_skew",
+    "obs_watchdog_secs", "obs_fsync", "obs_flight_events",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -510,6 +514,19 @@ class Config:
         # sample's skew — (max-median)/total per-shard wait — exceeds
         # this fraction
         "obs_straggler_warn_skew": ("float", 0.5),
+        # hang watchdog (obs/watchdog.py): dump a flight record
+        # (<events_path>.flight.json — event ring buffer, all thread
+        # stacks, device memory, metrics snapshot) when no iteration or
+        # host-collective progress lands within this many seconds.
+        # 0 = off.  The watchdog only observes; it never kills the run.
+        "obs_watchdog_secs": ("float", 0.0),
+        # os.fsync the timeline shard on run_end (and flight records
+        # always fsync) — survives a host dying mid-close at the cost
+        # of one sync per run
+        "obs_fsync": ("bool", False),
+        # size of the in-memory event ring buffer the flight record
+        # snapshots (last N events this rank emitted)
+        "obs_flight_events": ("int", 256),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -11,10 +11,22 @@ Prints one JSON line with the final model fingerprint + local AUC so the
 parent can assert cross-rank agreement and the single-process oracle.
 
 Usage: mp_worker.py <coordinator> <num_procs> <rank>
+
+Observability hooks (tests/test_multiprocess.py distributed-obs tests):
+
+* ``LGBM_MP_OBS_PATH``   — create a RunObserver on that events path; with
+  jax.distributed live it auto-shards to ``<path>.r<rank>`` and records
+  the host collectives of distributed bin finding plus per-round iter
+  events.
+* ``LGBM_MP_SLOW_RANK`` / ``LGBM_MP_SLOW_SECS`` — fault injection: that
+  rank sleeps before the distributed load and before every boosting
+  round, so the merged cross-rank view must attribute nonzero skew to
+  it.
 """
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -62,6 +74,21 @@ def main():
         cfg_keys["tpu_sparse"] = True
     cfg = Config(cfg_keys)
     comm = JaxProcessComm()
+
+    # distributed-obs hooks: observer AFTER the comm (rank context) and
+    # BEFORE from_matrix, so the loading collectives land in the shard
+    slow_rank = int(os.environ.get("LGBM_MP_SLOW_RANK", "-1"))
+    slow_secs = float(os.environ.get("LGBM_MP_SLOW_SECS", "0.2"))
+    obs = None
+    obs_path = os.environ.get("LGBM_MP_OBS_PATH", "")
+    if obs_path:
+        from lightgbm_tpu.obs import RunObserver
+        obs = RunObserver(events_path=obs_path, timing="iter")
+        obs.run_header(backend=jax.default_backend(), devices=[],
+                       params=dict(cfg_keys), context={"mode": mode})
+
+    if rank == slow_rank:
+        time.sleep(slow_secs)        # skew the loading collectives
     # distributed bin finding across REAL processes (this also min-syncs
     # the RNG-bearing params automatically, application.cpp:118-199)
     td = TrainingData.from_matrix(X_local, label=y_local, config=cfg,
@@ -79,12 +106,20 @@ def main():
         return p - y, p * (1.0 - p)
 
     trees = []
-    for _ in range(ROUNDS):
+    for it in range(ROUNDS):
+        if obs is not None:
+            obs.iter_begin(it)
+        if rank == slow_rank:
+            time.sleep(slow_secs)
         g, h = grads(score, y_dev)
         tree_dev, leaf_id = learner.train_device(g, h)
         score = dev_predict.update_score_from_partition(
             score, leaf_id, tree_dev.leaf_value, lr)
         trees.append(tree_dev)
+        if obs is not None:
+            obs.iter_end(it, value=score)
+    if obs is not None:
+        obs.close()
 
     # fingerprint: structure of every tree (replicated outputs, addressable
     # on all processes) + this rank's local AUC

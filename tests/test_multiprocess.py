@@ -30,12 +30,14 @@ def _free_port():
     return port
 
 
-def _run_workers(nproc, mode="dense"):
+def _run_workers(nproc, mode="dense", extra_env=None):
     coordinator = "127.0.0.1:%d" % _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own device count
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
     # worker output goes to FILES: a failing rank can dump >64 KB
     # (pipe-buffer size) of tracebacks, which with stdout=PIPE would
     # block it while the parent waits on another rank — a 540 s stall
@@ -65,6 +67,14 @@ def _run_workers(nproc, mode="dense"):
         f.close()
         os.unlink(f.name)
     for p, out in zip(procs, outs):
+        if (p.returncode != 0
+                and "Multiprocess computations aren't implemented"
+                in out):
+            # this jaxlib's CPU client has no cross-process collectives:
+            # an environment limit, not a code failure — same class as
+            # the reference skipping MPI tests without an MPI install
+            pytest.skip("jax CPU backend on this host lacks "
+                        "multiprocess collectives")
         assert p.returncode == 0, "worker failed:\n%s" % out[-3000:]
     results = {}
     for out in outs:
@@ -156,6 +166,53 @@ def test_two_process_data_parallel_training(dense_two_process):
         diff = have != want
         assert diff.sum() <= 1 and np.abs(have - want)[diff].max(
             initial=0) <= 1, (have.tolist(), want.tolist())
+
+
+def test_two_process_obs_shards_and_merge(tmp_path):
+    """Distributed observability over REAL processes: each rank writes
+    its own timeline shard (auto-suffixed .r<rank>), the run headers
+    carry rank/world_size, the loading collectives land as
+    host_collective events with aligned seq numbers, and `obs merge`
+    attributes the injected slow rank nonzero skew."""
+    base = str(tmp_path / "mp_events.jsonl")
+    _run_workers(2, extra_env={"LGBM_MP_OBS_PATH": base,
+                               "LGBM_MP_SLOW_RANK": "1",
+                               "LGBM_MP_SLOW_SECS": "0.3"})
+
+    from lightgbm_tpu.obs.merge import (discover_shards, load_shards,
+                                        merge_shards)
+    shards = discover_shards(base + ".r0")
+    assert [os.path.basename(p) for p in shards] == [
+        "mp_events.jsonl.r0", "mp_events.jsonl.r1"]
+
+    ranks = load_shards(shards)
+    assert set(ranks) == {0, 1}
+    for r, events in ranks.items():
+        hdr = events[0]
+        assert hdr["ev"] == "run_header"
+        assert hdr["rank"] == r and hdr["world_size"] == 2
+        assert any(e["ev"] == "host_collective" for e in events), \
+            "rank %d shard has no collective events" % r
+        assert events[-1]["ev"] == "run_end"
+        assert events[-1]["status"] == "ok"
+
+    merged, report = merge_shards(ranks)
+    assert report["world_size"] == 2
+    assert report["ranks"] == [0, 1]
+    # every collective must have both ranks aligned on its seq
+    assert report["collectives"]
+    for row in report["collectives"]:
+        assert row["ranks"] == [0, 1]
+        assert row["missing_ranks"] == []
+    # rank 1 slept 0.3 s before the load: the skew analysis must see it
+    assert report["collective_skew_max_s"] > 0.1
+    worst = max(report["collectives"], key=lambda r: r["skew_s"])
+    assert worst["last_rank"] == 1
+    # merged timeline stays a valid single-run view
+    hdr = merged[0]
+    assert hdr["ev"] == "run_header" and hdr["merged"] is True
+    assert merged[-1]["ev"] == "run_end"
+    assert merged[-1]["status"] == "ok"
 
 
 def test_two_process_sparse_store_matches_dense(dense_two_process):

@@ -1,0 +1,335 @@
+"""Distributed-run observability over the simulated-rank comm.
+
+run_ranks (parallel/comm.py) drives one thread per rank with a barrier
+at every collective, which makes it the fast fixture for everything the
+multi-host obs stack promises: per-rank timeline shards, cross-rank
+merge + skew attribution, the diagnosable barrier-timeout error, and
+the hang watchdog's flight-recorder dump.  The REAL multi-process
+versions live in tests/test_multiprocess.py; these stay in the
+seconds-fast tier.
+"""
+import glob
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lightgbm_tpu.obs import RunObserver, observer_from_config
+from lightgbm_tpu.obs.events import (EventWriter, RingBuffer,
+                                     resolve_rank_path)
+from lightgbm_tpu.obs.merge import (discover_shards, load_shards,
+                                    merge_shards, render_report,
+                                    write_merged)
+from lightgbm_tpu.obs.query import (load_timeline, render_summary,
+                                    main as obs_main)
+from lightgbm_tpu.parallel.comm import (BarrierTimeoutError, run_ranks,
+                                        rank_context)
+from lightgbm_tpu.utils.config import Config
+
+
+def _train_ranks(base, size, iters=3, slow_rank=None, slow_secs=0.05,
+                 **obs_kw):
+    """Simulated distributed run: each rank observer shards `base`,
+    every iteration gathers once (a host collective with a seq)."""
+
+    def work(comm):
+        obs = RunObserver(events_path=base, **obs_kw)
+        obs.run_header(backend="cpu", devices=[], params={}, context={})
+        for it in range(iters):
+            obs.iter_begin(it)
+            if comm.rank == slow_rank:
+                time.sleep(slow_secs)
+            comm.allgather_obj(it)
+            obs.iter_end(it)
+        obs.close()
+        return obs.events_path
+
+    return run_ranks(size, work)
+
+
+# -- per-rank sharding ----------------------------------------------------
+
+def test_resolve_rank_path():
+    # explicit template beats the auto-suffix
+    assert resolve_rank_path("ev_{rank}.jsonl", 2, 4) == "ev_2.jsonl"
+    # multi-rank runs auto-shard; single-rank paths stay untouched
+    assert resolve_rank_path("ev.jsonl", 1, 4) == "ev.jsonl.r1"
+    assert resolve_rank_path("ev.jsonl", 0, 1) == "ev.jsonl"
+    assert resolve_rank_path("", 1, 4) == ""
+
+
+def test_ranks_write_separate_shards(tmp_path):
+    base = str(tmp_path / "ev.jsonl")
+    paths = _train_ranks(base, 3)
+    assert paths == [base + ".r0", base + ".r1", base + ".r2"]
+    for r, p in enumerate(paths):
+        events = load_timeline(p)
+        hdr = events[0]
+        assert hdr["ev"] == "run_header"
+        assert hdr["rank"] == r
+        assert hdr["world_size"] == 3
+        assert hdr["coordinator"] == "run_ranks"
+        # every event past the header carries the rank
+        assert all(e.get("rank") == r for e in events)
+        # collectives recorded with monotonic seq
+        seqs = [e["seq"] for e in events if e["ev"] == "host_collective"]
+        assert seqs == sorted(seqs) and len(seqs) == 3
+
+
+def test_rank_context_cleared_after_run():
+    run_ranks(2, lambda comm: comm.allgather_obj(comm.rank))
+    assert rank_context() is None
+
+
+def test_observer_from_config_uses_comm(tmp_path):
+    base = str(tmp_path / "cfgev.jsonl")
+    cfg = Config({"obs_events_path": base, "verbose": -1})
+
+    def work(comm):
+        obs = observer_from_config(cfg, comm=comm)
+        assert obs.rank == comm.rank
+        assert obs.world_size == comm.size
+        assert obs.coordinator == "run_ranks"
+        obs.run_header(backend="cpu", devices=[], params={}, context={})
+        comm.allgather_obj(0)
+        obs.close()
+        return obs.events_path
+
+    paths = run_ranks(2, work)
+    assert paths == [base + ".r0", base + ".r1"]
+
+
+# -- cross-rank merge + skew ----------------------------------------------
+
+def test_merge_attributes_slow_rank(tmp_path):
+    base = str(tmp_path / "skew.jsonl")
+    _train_ranks(base, 4, slow_rank=2, slow_secs=0.06)
+    shards = discover_shards(base + ".r0")
+    assert len(shards) == 4
+    merged, report = merge_shards(load_shards(shards))
+    assert report["world_size"] == 4
+    assert report["ranks"] == [0, 1, 2, 3]
+    # injected sleep must show up as nonzero barrier skew pinned on r2
+    assert report["collective_skew_max_s"] > 0.03
+    worst = max(report["collectives"], key=lambda r: r["skew_s"])
+    assert worst["last_rank"] == 2
+    slowest = report["slowest_rank_collectives"]
+    assert max(slowest, key=lambda k: slowest[k]) == "2"
+    # merged critical-path iters: one per iteration, not per rank
+    iters = [e for e in merged if e["ev"] == "iter"]
+    assert len(iters) == 3
+    assert all(set(e["rank_times"]) == {"0", "1", "2", "3"}
+               for e in iters)
+    # rendered report names the straggler
+    buf = io.StringIO()
+    render_report(report, buf)
+    assert "rank 2" in buf.getvalue()
+
+
+def test_merge_single_rank_passthrough(tmp_path):
+    """A single-rank run merges to itself (degenerate world)."""
+    base = str(tmp_path / "solo.jsonl")
+    obs = RunObserver(events_path=base)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.iter_begin(0)
+    obs.iter_end(0)
+    obs.close()
+    merged, report = merge_shards(load_shards(discover_shards(base)))
+    assert report["world_size"] == 1
+    assert merged[0]["merged"] is True
+
+
+def test_merge_cli_roundtrip(tmp_path):
+    base = str(tmp_path / "cli.jsonl")
+    _train_ranks(base, 2)
+    out = str(tmp_path / "merged.jsonl")
+    assert obs_main(["merge", base + ".r0", "-o", out]) == 0
+    events = load_timeline(out)
+    assert events[0]["ev"] == "run_header"
+    assert events[0]["world_size"] == 2
+    # the merged view is itself summarizable
+    buf = io.StringIO()
+    render_summary(events, out=buf)
+    text = buf.getvalue()
+    assert "merged view of a 2-rank run" in text
+    assert "barrier skew" in text
+
+
+def test_summary_warns_on_single_shard_of_multirank_run(tmp_path):
+    base = str(tmp_path / "warn.jsonl")
+    _train_ranks(base, 2)
+    buf = io.StringIO()
+    render_summary(load_timeline(base + ".r1"), out=buf)
+    text = buf.getvalue()
+    assert "rank 1 of 2" in text
+    assert "ONE shard" in text
+    assert "obs merge" in text
+
+
+# -- barrier timeout diagnosis --------------------------------------------
+
+def test_barrier_timeout_names_missing_ranks():
+    def fault(rank, seq):
+        if rank == 3 and seq == 1:
+            time.sleep(1.0)            # past the 0.2 s barrier timeout
+
+    def work(comm):
+        for it in range(3):
+            comm.allgather_obj(it)
+
+    with pytest.raises(BarrierTimeoutError) as ei:
+        run_ranks(4, work, fault=fault, barrier_timeout=0.2)
+    err = ei.value
+    assert err.op == "allgather_obj" and err.seq == 1
+    assert err.arrived == [0, 1, 2]
+    assert err.missing == [3]
+    msg = str(err)
+    assert "[0, 1, 2]" in msg and "[3]" in msg and "seq 1" in msg
+    # stays catchable as the stdlib type (existing callers filter on it)
+    assert isinstance(err, threading.BrokenBarrierError)
+
+
+def test_peer_crash_beats_barrier_timeout():
+    """A rank that raises must surface ITS error, not the broken-barrier
+    echo its peers see."""
+
+    def work(comm):
+        if comm.rank == 1:
+            raise ValueError("rank 1 exploded")
+        comm.allgather_obj(comm.rank)
+
+    with pytest.raises(ValueError, match="rank 1 exploded"):
+        run_ranks(2, work, barrier_timeout=5.0)
+
+
+# -- hang watchdog + flight recorder --------------------------------------
+
+def test_watchdog_dumps_flight_record_on_hang(tmp_path):
+    """ISSUE acceptance path: injected hang in a simulated 4-rank run ->
+    per-rank flight-record JSON with the event ring buffer, the thread
+    stacks, and the hung collective's seq."""
+    base = str(tmp_path / "hang.jsonl")
+
+    def fault(rank, seq):
+        if rank == 3 and seq == 1:
+            time.sleep(1.2)
+
+    def work(comm):
+        obs = RunObserver(events_path=base, watchdog_secs=0.15)
+        obs.run_header(backend="cpu", devices=[], params={}, context={})
+        try:
+            for it in range(3):
+                obs.iter_begin(it)
+                comm.allgather_obj(it)
+                obs.iter_end(it)
+            obs.close()
+        except BaseException:
+            obs.close(status="aborted")
+            raise
+
+    with pytest.raises(BarrierTimeoutError) as ei:
+        run_ranks(4, work, fault=fault, barrier_timeout=0.5)
+    assert ei.value.missing == [3]
+
+    flights = sorted(glob.glob(base + ".r*.flight.json"))
+    assert flights, "watchdog wrote no flight record"
+    # a rank stuck in the barrier names the hung collective + seq
+    stuck = json.load(open(base + ".r0.flight.json"))
+    assert stuck["reason"] == "watchdog timeout"
+    assert stuck["label"] == "collective allgather_obj seq=1"
+    assert stuck["world_size"] == 4 and stuck["rank"] == 0
+    # ring buffer holds the events leading up to the hang
+    evs = stuck["events"]
+    assert any(e["ev"] == "run_header" for e in evs)
+    assert any(e["ev"] == "host_collective" and e["seq"] == 0
+               for e in evs)
+    # all thread stacks captured, including the hung rank threads
+    assert any("run_ranks-r" in k for k in stuck["threads"])
+    assert stuck["metrics"] is not None
+    assert stuck["devices"] is not None
+    # the shard's timeline records the watchdog firing and still ends
+    # with a parseable aborted run_end
+    events = load_timeline(base + ".r0")
+    assert any(e["ev"] == "health" and e["check"] == "watchdog"
+               for e in events)
+    assert events[-1]["ev"] == "run_end"
+    assert events[-1]["status"] == "aborted"
+
+
+def test_watchdog_quiet_on_healthy_run(tmp_path):
+    base = str(tmp_path / "ok.jsonl")
+    _train_ranks(base, 2, watchdog_secs=5.0)
+    assert glob.glob(base + "*.flight.json") == []
+    for p in (base + ".r0", base + ".r1"):
+        events = load_timeline(p)
+        assert events[-1]["status"] == "ok"
+        assert not any(e["ev"] == "health" for e in events)
+
+
+def test_flight_on_demand_without_watchdog(tmp_path):
+    """obs_health=fatal aborts dump a flight record even with the
+    watchdog off — the ring buffer is always live."""
+    base = str(tmp_path / "demand.jsonl")
+    obs = RunObserver(events_path=base)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.iter_begin(0)
+    obs.iter_end(0)
+    path = obs.flight("obs_health=fatal: loss_divergence",
+                      extra={"it": 0})
+    assert path == base + ".flight.json"
+    rec = json.load(open(path))
+    assert rec["reason"].startswith("obs_health=fatal")
+    assert rec["extra"] == {"it": 0}
+    assert any(e["ev"] == "iter" for e in rec["events"])
+    obs.close(status="aborted")
+    # close must not overwrite the specific record with a generic one
+    assert json.load(open(path))["reason"].startswith("obs_health=fatal")
+
+
+def test_ring_buffer_caps_and_counts_drops():
+    ring = RingBuffer(capacity=4)
+    for i in range(10):
+        ring.append({"i": i})
+    snap = ring.snapshot()
+    assert [e["i"] for e in snap] == [6, 7, 8, 9]
+    assert ring.dropped == 6
+    assert len(ring) == 4
+
+
+# -- writer durability ----------------------------------------------------
+
+def test_run_end_flushes_regardless_of_flush_every(tmp_path):
+    path = str(tmp_path / "flush.jsonl")
+    w = EventWriter(path, flush_every=10_000)
+    w.emit({"ev": "run_header", "t": 0.0, "run": "x", "schema": 4,
+            "backend": "cpu", "devices": [], "params": {}})
+    # nothing guaranteed on disk yet (buffered), but run_end must land
+    # without close() — the crash-forensics contract
+    w.emit({"ev": "run_end", "t": 1.0, "run": "x", "iters": 0,
+            "phase_totals": {}, "status": "aborted"})
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[-1])["ev"] == "run_end"
+    w.close()
+
+
+def test_fsync_writer_roundtrip(tmp_path):
+    path = str(tmp_path / "sync.jsonl")
+    obs = RunObserver(events_path=path, fsync=True)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.iter_begin(0)
+    obs.iter_end(0)
+    obs.close()
+    events = load_timeline(path)
+    assert events[-1]["ev"] == "run_end"
+
+
+def test_obs_config_params_and_aliases():
+    cfg = Config({"obs_watchdog": 30, "obs_events_fsync": True,
+                  "obs_ring_events": 64, "verbose": -1})
+    assert cfg.obs_watchdog_secs == 30.0
+    assert cfg.obs_fsync is True
+    assert cfg.obs_flight_events == 64

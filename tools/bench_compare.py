@@ -50,6 +50,10 @@ METRICS = {
     # compiles beyond the first per entry (compile_attr events);
     # tolerance 0: ANY new recompile vs a clean baseline is a failure
     "recompile_count": (-1, 0.0),
+    # worst first-vs-last barrier arrival gap across ranks (merged
+    # multi-rank timelines only — `obs merge` output); a growing skew
+    # means a rank got slower relative to its peers
+    "barrier_skew_max_s": (-1, 0.50),
 }
 
 
@@ -89,6 +93,12 @@ def _from_timeline(events):
             worst[e.get("entry")] = max(worst.get(e.get("entry"), 0),
                                         int(e.get("n_compiles", 1)))
         out["recompile_count"] = sum(n - 1 for n in worst.values())
+    # merged multi-rank timelines (`obs merge`) stamp per-collective
+    # barrier skew; absent on single-rank shards
+    skews = [float(e["skew_s"]) for e in events
+             if e.get("ev") == "host_collective" and "skew_s" in e]
+    if skews:
+        out["barrier_skew_max_s"] = max(skews)
     return out
 
 

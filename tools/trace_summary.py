@@ -106,6 +106,14 @@ def summarize_jsonl(path, csv=False, out=None):
         w("backend: %s  devices: %d  timing: %s" % (
             header.get("backend"), len(header.get("devices", [])),
             header.get("timing")))
+        ws = int(header.get("world_size", 1) or 1)
+        if header.get("merged"):
+            w("merged view: %d-rank run (ranks %s)" % (
+                ws, header.get("merged_ranks", [])))
+        elif ws > 1:
+            w("rank: %d of %d — ONE shard; merge for the cross-rank "
+              "view (python -m lightgbm_tpu obs merge %s)" % (
+                  int(header.get("rank", 0)), ws, path))
         w("learner: %s" % (", ".join(
             "%s=%s" % (k, ctx[k]) for k in sorted(ctx))))
     fenced = all(e.get("fenced") for e in iters) if iters else False
@@ -139,6 +147,21 @@ def summarize_jsonl(path, csv=False, out=None):
                    or "first compile")
             w("  %-12s %4d %5d  %s" % (r["entry"], r["n_compiles"],
                                        r["sig_compiles"], why))
+
+    rank_report = (run_end or {}).get("rank_report")
+    if rank_report:
+        # merged cross-rank view: per-rank totals + barrier skew
+        w("\n== per-rank comparison (merged view) ==")
+        w("  %-6s %12s  %s" % ("rank", "iter_total_s", "slowest in"))
+        slowest = rank_report.get("slowest_rank_collectives", {})
+        for r, t in sorted(rank_report.get("per_rank_iter_total_s",
+                                           {}).items(),
+                           key=lambda kv: int(kv[0])):
+            w("  r%-5s %12.4f  %s collective(s)"
+              % (r, t, slowest.get(str(r), 0)))
+        w("max barrier skew: %.6f s (seq %s)" % (
+            rank_report.get("collective_skew_max_s", 0.0),
+            rank_report.get("collective_skew_max_seq")))
 
     stragglers = query.straggler_rows(events)
     if stragglers:
