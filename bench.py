@@ -411,6 +411,10 @@ def child():
         "unit": "iters/sec",
         "vs_baseline": (round(ips / BASELINE_ITERS_PER_SEC, 3)
                         if flagship else None),
+        # model-quality guardrail next to the perf number: bench_compare
+        # gates on it so a kernel "speedup" that costs accuracy fails
+        "final_eval_metric": round(float(auc), 6),
+        "final_eval_name": "auc",
     }))
 
 
@@ -437,14 +441,20 @@ def dry():
               "verbose": -1, "obs_events_path": obs_path,
               "obs_timing": "iter", "obs_memory_every": 2,
               "obs_health": "warn", "obs_metrics_every": 2,
-              "obs_compile": True}
+              "obs_compile": True, "obs_split_audit": True,
+              "obs_importance_every": 2}
     lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
 
     evs = read_events(obs_path)          # validates every record
     kinds = [e["ev"] for e in evs]
     for need in ("run_header", "iter", "compile", "compile_attr",
-                 "memory", "health", "metrics", "run_end"):
+                 "memory", "health", "metrics", "run_end",
+                 "data_profile", "split_audit", "importance"):
         assert need in kinds, "timeline missing %r events" % need
+    audits = [e for e in evs if e["ev"] == "split_audit"]
+    assert all(e["splits"] for e in audits), "empty split_audit event"
+    assert all(s["gain"] > 0 for e in audits for s in e["splits"]), \
+        "split_audit recorded a non-positive realized gain"
     attr = [e for e in evs if e["ev"] == "compile_attr"]
     thrash = [e for e in attr if e.get("sig_compiles", 1) > 1]
     assert not thrash, "shape-stable dry run recompiled an already-" \

@@ -717,11 +717,16 @@ class Booster:
 
     # -------------------------------------------------------------- predict
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
-                pred_leaf: bool = False, data_has_header: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                data_has_header: bool = False,
                 is_reshape: bool = True, pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0):
         """Predict rows (numpy/pandas/CSR/CSC or a data file path).
+
+        ``pred_contrib=True`` returns per-feature contributions
+        (N, num_features + 1) — gain-weighted path attribution, last
+        column = bias; rows sum to the raw score (GBDT.pred_contrib).
 
         The serving choke point: per-request latency and batch size land
         in the process metrics registry (lightgbm_tpu/obs/metrics.py) —
@@ -734,13 +739,21 @@ class Booster:
         from .obs.metrics import observe_predict
         t0 = _time.perf_counter()
         out = self._predict_data(data, num_iteration, raw_score, pred_leaf,
-                                 data_has_header)
+                                 pred_contrib, data_has_header)
         observe_predict(np.asarray(out).shape[0] if np.ndim(out) else 1,
                         _time.perf_counter() - t0)
         return out
 
     def _predict_data(self, data, num_iteration, raw_score, pred_leaf,
-                      data_has_header):
+                      pred_contrib, data_has_header):
+        def run(block):
+            if pred_contrib:
+                return self._gbdt.pred_contrib(block,
+                                               num_iteration=num_iteration)
+            return self._gbdt.predict(block, num_iteration=num_iteration,
+                                      raw_score=raw_score,
+                                      pred_leaf=pred_leaf)
+
         if isinstance(data, str):
             from .io import parser as _parser
             parsed = _parser.parse_file(data, has_header=data_has_header)
@@ -754,18 +767,14 @@ class Booster:
             if isinstance(mat, SparseColumns):
                 # bounded-memory sparse prediction: densify row chunks
                 # (tree traversal wants raw values, O(chunk * F) at a time)
-                outs = [self._gbdt.predict(block,
-                                           num_iteration=num_iteration,
-                                           raw_score=raw_score,
-                                           pred_leaf=pred_leaf)
+                outs = [run(block)
                         for _, block in iter_dense_row_chunks(mat)]
                 return (np.concatenate(outs) if outs
                         else np.zeros(0, dtype=np.float64))
             mat = np.asarray(mat, dtype=np.float64)
             if mat.ndim == 1:
                 mat = mat.reshape(1, -1)
-        return self._gbdt.predict(mat, num_iteration=num_iteration,
-                                  raw_score=raw_score, pred_leaf=pred_leaf)
+        return run(mat)
 
     # ------------------------------------------------------------ model I/O
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
@@ -790,6 +799,14 @@ class Booster:
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """Per-feature importance: 'split' counts or total 'gain'."""
         return self._gbdt.feature_importance(importance_type)
+
+    def importance_history(self, importance_type: str = "split") -> list:
+        """Importance trajectory from the telemetry timeline — the
+        ``importance`` events written at the ``obs_importance_every``
+        cadence, as ``[{"it", "importance": {feature_index: value}}]``.
+        Empty when importance tracking was off for this run."""
+        from .obs.model import importance_history as _history
+        return _history(self.telemetry(), importance_type)
 
     def feature_name(self) -> List[str]:
         """Feature names of the training data."""

@@ -108,6 +108,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 if is_valid_contain_train:
                     evaluation_result_list.extend(booster.eval_train(feval))
                 evaluation_result_list.extend(booster.eval_valid(feval))
+                # metric values double as timeline `eval` events — the
+                # convergence/overfit-gap surface for `obs explain` and
+                # bench_compare's final_eval_metric gate (the CLI path
+                # gets the same events from GBDT.output_metric)
+                obs = booster._gbdt._obs
+                if obs.enabled and evaluation_result_list:
+                    obs.event("eval", it=i, results=[
+                        {"dataset": str(n), "metric": str(m),
+                         "value": float(v)}
+                        for n, m, v, _ in evaluation_result_list])
             try:
                 for cb in cbs_after:
                     cb(callback_mod.CallbackEnv(model=booster, params=params,

@@ -152,6 +152,7 @@ class BinMapper:
                 self.max_val = vmax
                 self.default_bin = default_bin
                 self.sparse_rate = sparse_rate
+                self._count_single_bucket()
                 return
         num_sample_values = len(values)
         zero_cnt = int(total_sample_cnt - num_sample_values)
@@ -200,6 +201,19 @@ class BinMapper:
             self.default_bin = int(self.value_to_bin(0.0))
         self.sparse_rate = float(cnt_in_bin[self.default_bin]) / total_sample_cnt \
             if len(cnt_in_bin) > self.default_bin else 0.0
+        self._count_single_bucket()
+
+    def _count_single_bucket(self) -> None:
+        """Metrics-registry count of constant features (num_bin <= 1) —
+        dataset-construction cost, so it stays on even when obs is off.
+        The per-dataset one-line warning naming the features lives in
+        io/dataset.py where the feature indices are known."""
+        if self.num_bin <= 1:
+            from ..obs.metrics import REGISTRY
+            REGISTRY.counter(
+                "dataset_single_bucket_features_total",
+                "features that binned into a single bucket (constant)",
+            ).inc()
 
     def _find_bin_numerical(self, dv, cv, num_distinct, total_sample_cnt,
                             max_bin, min_data_in_bin):

@@ -58,6 +58,10 @@ class TrainingData:
         self.raw_data: Optional[np.ndarray] = None    # kept for valid alignment
         # EFB layout (io/bundle.py); None = binned is per-feature raw bins
         self.bundle: Optional[BundleLayout] = None
+        # data-quality profile of the binning sample (obs/dataquality.py);
+        # None when binning was copied/loaded rather than fitted here
+        self._data_profile: Optional[dict] = None
+        self._comm = None
 
     # ------------------------------------------------------------- construct
     @classmethod
@@ -187,6 +191,17 @@ class TrainingData:
             self.real_to_inner = {r: i for i, r in
                                   enumerate(self.used_feature_idx)}
             self._build_feature_arrays()
+
+            def col_from_cache(f):
+                # sampled column densified: implicit zeros + nonzero
+                # scatter (NaN entries preserved by the cache)
+                spos, sv = col_sample_cache[f]
+                col = np.zeros(total_sample, dtype=np.float64)
+                if len(spos):
+                    col[spos] = sv
+                return col
+            self._profile_quality(col_from_cache, total_sample, cats,
+                                  config)
 
             # EFB on the binning sample, rebuilt sparsely (dense path:
             # Dataset::Construct, dataset.cpp:229-235)
@@ -375,6 +390,8 @@ class TrainingData:
             Log.warning("There are no meaningful features, as all feature values are constant.")
         self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
         self._build_feature_arrays()
+        self._profile_quality(lambda f: sample[:, f], total_sample,
+                              categorical, config)
 
         # EFB on the binning sample (Dataset::Construct, dataset.cpp:229-235)
         if (config.enable_bundle and len(self.used_feature_idx) > 1
@@ -440,6 +457,9 @@ class TrainingData:
                         "values are constant.")
         self.real_to_inner = {r: i for i, r in enumerate(self.used_feature_idx)}
         self._build_feature_arrays()
+        # rank-local sample: the profile reflects this rank's row shard
+        self._profile_quality(lambda f: sample[:, f], total_sample,
+                              categorical, config)
 
         # EFB under distribution: every rank MUST end with the identical
         # group structure (histogram psums assume one layout), so rank 0
@@ -489,6 +509,27 @@ class TrainingData:
                       data.shape[1], reference.num_total_features)
         self._copy_binning_from(reference)
         self._bin_data(data)
+
+    def _profile_quality(self, get_col, sample_size: int, categorical: set,
+                         config: Config) -> None:
+        """Post-binning quality pass: the single-bucket warning (always on
+        — it costs one scan of the mappers) plus the data-quality profile
+        the Booster emits as a ``data_profile`` obs event
+        (``obs_data_profile``, default on)."""
+        single = [i for i, m in enumerate(self.bin_mappers)
+                  if m is not None and m.num_bin <= 1]
+        if single:
+            head = ",".join(str(i) for i in single[:20])
+            Log.warning(
+                "%d feature(s) binned into a single bucket (constant, "
+                "never splittable): %s%s", len(single), head,
+                ",..." if len(single) > 20 else "")
+        if not bool(getattr(config, "obs_data_profile", True)):
+            return
+        from ..obs import dataquality
+        self._data_profile = dataquality.profile_columns(
+            self.bin_mappers, get_col, self.num_total_features,
+            sample_size, categorical)
 
     def _build_feature_arrays(self) -> None:
         used = self.used_feature_idx

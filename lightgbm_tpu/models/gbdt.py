@@ -125,6 +125,14 @@ class GBDT:
         self._obs = observer_from_config(
             config, comm=getattr(self.train_data, "_comm", None))
         self._metrics = None
+        # model-observability cadence (obs/model.py): split audit + top-k
+        # importance snapshots, both host-side on materialized trees
+        self._obs_split_audit = bool(getattr(config, "obs_split_audit",
+                                             False))
+        self._obs_importance_every = int(
+            getattr(config, "obs_importance_every", 0) or 0)
+        self._obs_importance_topk = int(
+            getattr(config, "obs_importance_topk", 20) or 20)
         if self._obs.enabled:
             devices = [{"id": int(d.id), "platform": str(d.platform),
                         "kind": str(getattr(d, "device_kind", ""))}
@@ -154,6 +162,23 @@ class GBDT:
                     "lgbm_dataset_bins_built_total",
                     "feature-discretization bins constructed for "
                     "training datasets").inc(int(np.sum(nbins)))
+            # data-quality profile captured at Dataset construction
+            # (io/dataset.py _profile_quality); may Log.fatal under
+            # obs_health=fatal on a degenerate dataset — before any
+            # iteration burns device time
+            profile = getattr(self.train_data, "_data_profile", None)
+            if (profile is not None
+                    and bool(getattr(config, "obs_data_profile", True))):
+                from ..obs import dataquality
+                label_prof = dataquality.label_profile(
+                    self.train_data.metadata.label)
+                findings = dataquality.build_findings(
+                    profile, label_prof,
+                    getattr(self.train_data, "feature_names", None))
+                dataquality.emit_data_profile(
+                    self._obs, profile, label_prof, findings,
+                    health_mode=str(getattr(config, "obs_health", "off")
+                                    or "off").strip().lower())
         self.learner.set_observer(self._obs)
 
     def reset_config(self, config: Config) -> None:
@@ -424,6 +449,10 @@ class GBDT:
         obs = self._obs
         it0 = self.iter
         obs.iter_begin(it0)
+        # split-audit needs to know which models this iteration appends
+        # (includes the iteration-0 boost_from_average stub, which the
+        # audit emitter skips — a stub has no realized split to record)
+        start_models = len(self.models)
         # boost from average (gbdt.cpp:341-362)
         if (not self.models and cfg.boost_from_average
                 and not self.has_init_score and self.num_class <= 1
@@ -559,6 +588,7 @@ class GBDT:
             obs.iter_end(it0, value=self._score_dev, stopped=True)
             return True
         self.iter += 1
+        self._emit_model_obs(it0, start_models)
         if is_eval:
             stop = self.eval_and_check_early_stopping()
             obs.lap("eval")
@@ -566,6 +596,31 @@ class GBDT:
             return stop
         obs.iter_end(it0, value=self._score_dev)
         return False
+
+    def _emit_model_obs(self, it0: int, start_models: int) -> None:
+        """Split-audit + importance events for this iteration (obs/model.py).
+
+        Costs a _materialize (device sync) when due, so both are opt-in:
+        ``obs_split_audit`` audits every iteration's new trees;
+        ``obs_importance_every=N`` snapshots top-k importance every N
+        iterations."""
+        if not self._obs.enabled:
+            return
+        every = self._obs_importance_every
+        imp_due = every > 0 and (it0 % every) == 0
+        if not self._obs_split_audit and not imp_due:
+            return
+        from ..obs import model as obs_model
+        self._materialize()
+        if self._obs_split_audit:
+            for t in range(start_models, len(self.models)):
+                obs_model.emit_split_audit(self._obs, it0, t,
+                                           self.models[t])
+        if imp_due:
+            obs_model.emit_importance(
+                self._obs, it0, self.feature_importance("split"),
+                self.feature_importance("gain"),
+                self._obs_importance_topk)
 
     def _bagging_with_grad(self, it, g_dev, h_dev):
         """Hook: base bagging ignores gradients; GOSS overrides."""
@@ -647,6 +702,10 @@ class GBDT:
         ret = ""
         msg_lines: List[str] = []
         meet_pairs: List[Tuple[int, int]] = []
+        # metric values double as timeline `eval` events (convergence /
+        # overfit-gap surface for `obs explain` and bench_compare's
+        # final_eval_metric gate); None when the observer is off
+        eval_results = [] if self._obs.enabled else None
         if need_output:
             for m in self.training_metrics:
                 scores = m.eval(self.train_score, self.objective)
@@ -655,6 +714,10 @@ class GBDT:
                     Log.info(line)
                     if self.early_stopping_round > 0:
                         msg_lines.append(line)
+                    if eval_results is not None:
+                        eval_results.append({"dataset": "training",
+                                             "metric": name,
+                                             "value": float(s)})
         if need_output or self.early_stopping_round > 0:
             for i in range(len(self.valid_metrics)):
                 for j, m in enumerate(self.valid_metrics[i]):
@@ -665,6 +728,10 @@ class GBDT:
                             Log.info(line)
                         if self.early_stopping_round > 0:
                             msg_lines.append(line)
+                        if eval_results is not None:
+                            eval_results.append(
+                                {"dataset": "valid_%d" % (i + 1),
+                                 "metric": name, "value": float(s)})
                     if not ret and self.early_stopping_round > 0:
                         cur = m.factor_to_bigger_better * test_scores[-1]
                         if cur > self.best_score[i][j]:
@@ -673,6 +740,8 @@ class GBDT:
                             meet_pairs.append((i, j))
                         elif it - self.best_iter[i][j] >= self.early_stopping_round:
                             ret = self.best_msg[i][j]
+        if eval_results:
+            self._obs.event("eval", it=it, results=eval_results)
         msg = "\n".join(msg_lines)
         for i, j in meet_pairs:
             self.best_msg[i][j] = msg
@@ -833,6 +902,40 @@ class GBDT:
         cols = [self.models[t].predict_leaf_index(features)
                 for t in range(num_used)]
         return np.stack(cols, axis=1) if cols else np.zeros((features.shape[0], 0), np.int32)
+
+    def pred_contrib(self, features: np.ndarray, num_iteration: int = -1,
+                     per: str = "feature") -> np.ndarray:
+        """Prediction attribution (debug path, host-only, f64 exact).
+
+        per='tree': (N, num_used) matrix of each tree's contribution —
+        column t sums into raw-score class t % num_tree_per_iteration, so
+        summing the columns of a class reproduces predict_raw exactly.
+
+        per='feature': gain-weighted path attribution per tree
+        (Tree.predict_contrib), summed over trees.  Returns
+        (N, num_features + 1) for single-output models — the last column
+        is the bias (stub trees and zero-gain paths) — and
+        (N, k, num_features + 1) for multi-class.  Rows sum to the raw
+        score by construction.
+        """
+        if per not in ("feature", "tree"):
+            raise KeyError("pred_contrib per must be 'feature' or 'tree'")
+        self._materialize()
+        features = np.ascontiguousarray(np.asarray(features,
+                                                   dtype=np.float64))
+        n = features.shape[0]
+        k = self.num_tree_per_iteration
+        num_used = self._used_trees(num_iteration)
+        if per == "tree":
+            out = np.zeros((n, num_used), dtype=np.float64)
+            for t in range(num_used):
+                out[:, t] = self.models[t].predict(features)
+            return out
+        nf = self.max_feature_idx + 1
+        out = np.zeros((n, k, nf + 1), dtype=np.float64)
+        for t in range(num_used):
+            out[:, t % k, :] += self.models[t].predict_contrib(features, nf)
+        return out[:, 0, :] if k == 1 else out
 
     # ------------------------------------------------------------- model I/O
     def sub_model_name(self) -> str:

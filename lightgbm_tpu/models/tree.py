@@ -41,6 +41,12 @@ class Tree:
         self.split_gain = np.zeros(n - 1, dtype=np.float64)
         self.internal_value = np.zeros(n - 1, dtype=np.float64)
         self.internal_count = np.zeros(n - 1, dtype=np.int64)
+        # split-audit runner-up (runtime-only; NOT part of the text format):
+        # real feature index of the second-best candidate at each split and
+        # its gain — -1 / 0 when the winner had no competitor (including
+        # trees loaded from the text format, which never carry these)
+        self.second_feature = np.full(n - 1, -1, dtype=np.int32)
+        self.second_gain = np.zeros(n - 1, dtype=np.float64)
         # per leaf (n)
         self.leaf_parent = np.zeros(n, dtype=np.int32)
         self.leaf_value = np.zeros(n, dtype=np.float64)
@@ -142,6 +148,60 @@ class Tree:
             node[idx] = np.where(go_left, self.left_child[nd], self.right_child[nd])
             active = node >= 0
         return (~node).astype(np.int32)
+
+    def predict_contrib(self, features: np.ndarray,
+                        num_features: int) -> np.ndarray:
+        """Gain-weighted per-feature attribution of this tree's output.
+
+        One descent (same semantics as predict_leaf_index) recording the
+        visited nodes per depth level; each row's leaf value is then
+        distributed over its path's split features proportionally to
+        split gain.  Returns (N, num_features + 1): the last column is
+        the bias — rows whose path carries no positive gain (stub trees,
+        loaded models without gains) put the whole leaf value there.
+        Rows sum to predict(features) up to one rounding per path node.
+        """
+        n = features.shape[0]
+        out = np.zeros((n, num_features + 1), dtype=np.float64)
+        if self.num_leaves <= 1:
+            return out
+        values = self.leaf_value[self.predict_leaf_index(features)]
+        steps = []          # (rows, nodes) per depth level of the descent
+        node = np.zeros(n, dtype=np.int32)
+        active = node >= 0
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            steps.append((idx, nd))
+            feat = self.split_feature[nd]
+            fval = features[idx, feat]
+            dv = self.default_value[nd]
+            use_default = (fval > -kMissingValueRange) & \
+                (fval <= kMissingValueRange)
+            fval = np.where(use_default, dv, fval)
+            is_cat = self.decision_type[nd] == 1
+            th = self.threshold[nd]
+            with np.errstate(invalid="ignore"):
+                go_left = np.where(
+                    is_cat,
+                    fval.astype(np.int64, copy=False) == th.astype(np.int64),
+                    fval <= th)
+            node[idx] = np.where(go_left, self.left_child[nd],
+                                 self.right_child[nd])
+            active = node >= 0
+        total = np.zeros(n, dtype=np.float64)
+        for idx, nd in steps:
+            g = self.split_gain[nd]
+            total[idx] += np.where(g > 0, g, 0.0)
+        no_gain = total <= 0
+        out[no_gain, num_features] = values[no_gain]
+        scale = np.where(no_gain, 0.0,
+                         values / np.where(no_gain, 1.0, total))
+        for idx, nd in steps:
+            g = self.split_gain[nd]
+            np.add.at(out, (idx, self.split_feature[nd]),
+                      np.where(g > 0, g, 0.0) * scale[idx])
+        return out
 
     def add_prediction_to_score(self, binned: np.ndarray, score: np.ndarray,
                                 used_feature_idx: List[int]) -> None:

@@ -26,8 +26,18 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
 * ``straggler`` — sampled per-shard arrival-skew profiling of the
   distributed learners (``obs_straggler_every`` /
   ``obs_straggler_warn_skew``);
+* ``model``   — model observability: per-tree ``split_audit`` events
+  (every realized split + the runner-up feature/gain margin from the
+  split search) and top-k sparse ``importance`` evolution events
+  (``obs_split_audit`` / ``obs_importance_every`` /
+  ``obs_importance_topk``), read back via ``Booster.importance_history``;
+* ``dataquality`` — dataset profiling at construction: per-feature
+  missing rate, bin-occupancy entropy, constant/near-constant and
+  high-cardinality flags, label balance — emitted as a ``data_profile``
+  event and routed through the health channel so a degenerate dataset
+  fails fast under ``obs_health=fatal``;
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
-  obs summary|recompiles|stragglers|merge|diff|trace``;
+  obs summary|recompiles|stragglers|explain|merge|diff|trace``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -46,7 +56,8 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_memory_every``, ``obs_trace_iters``, ``obs_trace_dir``,
 ``obs_flush_every``, ``obs_fsync``, ``obs_health*``, ``obs_metrics*``,
 ``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``,
-``obs_watchdog_secs``, ``obs_flight_events``.
+``obs_watchdog_secs``, ``obs_flight_events``, ``obs_split_audit``,
+``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -88,9 +99,10 @@ def observer_from_config(config, comm=None):
 
     Any of ``obs_events_path`` / ``obs_trace_iters`` / ``obs_memory_every``
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
-    ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every``
-    enables the observer; health, metrics and compile tracking work
-    without an events path (in-memory timeline via Booster.telemetry()).
+    ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every`` /
+    ``obs_split_audit`` / ``obs_importance_every`` enables the observer;
+    health, metrics, compile and model tracking work without an events
+    path (in-memory timeline via Booster.telemetry()).
     """
     events_path = str(getattr(config, "obs_events_path", "") or "")
     trace_iters = str(getattr(config, "obs_trace_iters", "") or "")
@@ -104,10 +116,13 @@ def observer_from_config(config, comm=None):
     metrics_every = int(getattr(config, "obs_metrics_every", 0) or 0)
     compile_attr = bool(getattr(config, "obs_compile", False))
     straggler_every = int(getattr(config, "obs_straggler_every", 0) or 0)
+    split_audit = bool(getattr(config, "obs_split_audit", False))
+    importance_every = int(getattr(config, "obs_importance_every", 0) or 0)
     if (not events_path and not trace_iters and memory_every <= 0
             and health_mode == "off" and not metrics_path
             and metrics_every <= 0 and not compile_attr
-            and straggler_every <= 0):
+            and straggler_every <= 0 and not split_audit
+            and importance_every <= 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:

@@ -22,6 +22,14 @@ host_collective op, seq, dur_s (schema 4; parallel/comm.py — one host
                barrier/allgather with its monotonic sequence number)
 health         check, status, it (schema 2; obs/health.py monitors)
 metrics        it, scrape (schema 2; obs/metrics.py registry snapshot)
+split_audit    it, tree, splits (schema 5; obs/model.py — every realized
+               split's feature/threshold/gain + runner-up margin)
+importance     it, features (schema 5; obs/model.py — top-k sparse
+               split/gain importance snapshot)
+data_profile   n_features (schema 5; obs/dataquality.py — per-feature
+               missing rate / entropy / degeneracy flags, label balance)
+eval           it, results (schema 5; per-iteration eval-metric values,
+               the convergence surface `obs explain` reads)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -57,10 +65,11 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 4
-# schema 1 (no health/metrics), 2 (no compile_attr/straggler) and
-# 3 (rank-less, no host_collective) timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4)
+SCHEMA_VERSION = 5
+# schema 1 (no health/metrics), 2 (no compile_attr/straggler),
+# 3 (rank-less, no host_collective) and 4 (no model/data events)
+# timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -79,6 +88,13 @@ _REQUIRED = {
     "host_collective": ("op", "seq", "dur_s"),
     "health": ("check", "status", "it"),
     "metrics": ("it", "scrape"),
+    # schema 5 (obs/model.py + obs/dataquality.py): model & data
+    # observability — split audit trail, importance evolution, dataset
+    # profile, per-iteration eval values
+    "split_audit": ("it", "tree", "splits"),
+    "importance": ("it", "features"),
+    "data_profile": ("n_features",),
+    "eval": ("it", "results"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 

@@ -13,6 +13,10 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   (jit-cache thrash), the CI gate;
 * ``stragglers RUN.jsonl``    — per-sample skew + slowest-device
   attribution from ``straggler`` events;
+* ``explain RUN.jsonl``       — model & data report from the
+  ``data_profile`` / ``importance`` / ``split_audit`` / ``eval`` events:
+  suspicious-data findings, top-feature evolution, gain-margin summary
+  and convergence; ``--check`` exits 1 on error-severity data findings;
 * ``merge RUN.jsonl [-o M.jsonl]`` — discover the per-rank shards of a
   distributed run (``RUN.jsonl.r0`` ...), align them on iteration /
   collective ``seq`` (obs/merge.py), print per-collective barrier skew,
@@ -273,6 +277,139 @@ def render_stragglers(events, out=None):
                          summ.get("slowest_counts", {})))
 
 
+def render_explain(events, out=None, topk=10):
+    """Model & data-quality report of one run (the ``obs explain``
+    subcommand).  Returns True iff the data profile carries an
+    error-severity finding — the --check failure condition."""
+    from .model import audit_margin_stats, importance_history
+    out = out or sys.stdout
+    w = lambda s="": out.write(s + "\n")
+    has_error = False
+    wrote = False
+
+    # ------------------------------------------------------- data quality
+    for e in (ev for ev in events if ev.get("ev") == "data_profile"):
+        wrote = True
+        w("data profile (%s): %d features, sample %d"
+          % (e.get("dataset", "train"), e.get("n_features", 0),
+             e.get("sample_size", 0)))
+        parts = []
+        if e.get("mean_missing_rate") is not None:
+            parts.append("mean missing rate %.4g" % e["mean_missing_rate"])
+        if e.get("mean_entropy") is not None:
+            parts.append("mean bin entropy %.3f" % e["mean_entropy"])
+        for key in ("constant", "filtered", "near_constant",
+                    "high_cardinality"):
+            n = len(e.get(key) or ())
+            if n:
+                parts.append("%s %d" % (key, n))
+        if parts:
+            w("  " + "  ".join(parts))
+        label = e.get("label") or {}
+        if label.get("n_distinct") is not None:
+            line = "  label: %d distinct value(s)" % label["n_distinct"]
+            if label.get("min_class_frac") is not None:
+                line += ", minority class fraction %.4g" \
+                    % label["min_class_frac"]
+            w(line)
+        findings = e.get("findings") or []
+        for fd in findings:
+            w("  [%s] %s" % (fd.get("severity", "?"),
+                             fd.get("message", "")))
+            if fd.get("severity") == "error":
+                has_error = True
+        if not findings:
+            w("  no data-quality findings")
+
+    # ----------------------------------------------- importance evolution
+    hist = importance_history(events, "gain")
+    if hist:
+        wrote = True
+        final = hist[-1]["importance"]
+        top = sorted(final, key=lambda f: -final[f])[:topk]
+        idxs = list(range(len(hist)))
+        if len(idxs) > 6:       # cap the table at 6 snapshot columns
+            step = (len(idxs) - 1) / 5.0
+            idxs = sorted({int(round(i * step)) for i in range(6)})
+        cols = [hist[i] for i in idxs]
+        w()
+        w("top %d features by final gain (%d importance snapshots):"
+          % (len(top), len(hist)))
+        w("  %-10s" % "feature"
+          + "".join("%12s" % ("it=%d" % h["it"]) for h in cols))
+        for f in top:
+            w("  %-10d" % f
+              + "".join("%12.4g" % h["importance"].get(f, 0.0)
+                        for h in cols))
+
+    # ------------------------------------------------------- gain margins
+    stats = audit_margin_stats(events)
+    if stats:
+        wrote = True
+        w()
+        w("split-audit gain margins (margin_rel = (gain - runner_up_gain)"
+          " / gain):")
+        w("  %8s %7s %11s %10s %11s  %s"
+          % ("feature", "splits", "total_gain", "contested", "med_margin",
+             "top runner-up"))
+        rows = sorted(stats.items(), key=lambda kv: -kv[1]["total_gain"])
+        for f, st in rows[:15]:
+            ru = (max(st["runner_ups"].items(), key=lambda kv: kv[1])
+                  if st["runner_ups"] else None)
+            med = st["median_margin_rel"]
+            w("  %8d %7d %11.4g %9d%% %11s  %s"
+              % (f, st["splits"], st["total_gain"],
+                 int(round(100.0 * st["contested"]
+                           / max(st["splits"], 1))),
+                 "%.3f" % med if med is not None else "-",
+                 ("f%d x%d" % ru) if ru else "-"))
+        close = sorted(f for f, st in stats.items()
+                       if st["median_margin_rel"] is not None
+                       and st["median_margin_rel"] < 0.1)
+        if close:
+            w("  NOTE: near-coin-flip features (median margin_rel < 0.1):"
+              " %s — correlated/interchangeable candidates"
+              % ",".join(map(str, close)))
+
+    # -------------------------------------------------------- convergence
+    series = {}
+    for e in (ev for ev in events if ev.get("ev") == "eval"):
+        for r in e.get("results") or ():
+            series.setdefault((str(r.get("dataset")), str(r.get("metric"))),
+                              []).append((int(e.get("it", -1)),
+                                          float(r.get("value", 0.0))))
+    if series:
+        wrote = True
+        w()
+        w("convergence (eval events):")
+        for (ds, metric), pts in sorted(series.items()):
+            pts.sort()
+            vals = [v for _, v in pts]
+            best = max(vals) if vals[-1] >= vals[0] else min(vals)
+            w("  %s %s: first %.6g  best %.6g  last %.6g  (%d points)"
+              % (ds, metric, vals[0], best, vals[-1], len(pts)))
+        for (ds, metric), pts in sorted(series.items()):
+            if ds != "training":
+                continue
+            # first validation series of the same metric (the engine path
+            # names them valid_0..., the CLI path valid_1...)
+            vds = next((d for (d, m) in sorted(series)
+                        if d != "training" and m == metric), None)
+            if vds is not None:
+                vpts = series[(vds, metric)]
+                gap = sorted(vpts)[-1][1] - sorted(pts)[-1][1]
+                w("  generalization gap (%s): training %.6g vs %s "
+                  "%.6g (gap %+.6g)"
+                  % (metric, sorted(pts)[-1][1], vds,
+                     sorted(vpts)[-1][1], gap))
+
+    if not wrote:
+        w("no model/data events — train with obs_split_audit=true, "
+          "obs_importance_every=N and/or obs_data_profile=true (plus any "
+          "obs_* output) to populate them")
+    return has_error
+
+
 _DIFF_KEYS = ("iters", "iters_per_sec", "total_s", "compile_s",
               "recompile_count", "peak_mem_bytes", "straggler_max_skew",
               "barrier_skew_max_s")
@@ -370,13 +507,19 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name, hlp in (("summary", "headline metrics of the last run"),
                       ("recompiles", "compile_attr events + diffs"),
-                      ("stragglers", "per-device arrival skew samples")):
+                      ("stragglers", "per-device arrival skew samples"),
+                      ("explain", "model & data-quality report: top "
+                                  "features, gain margins, findings")):
         p = sub.add_parser(name, help=hlp)
         p.add_argument("timeline")
         if name == "recompiles":
             p.add_argument("--check", action="store_true",
                            help="exit 1 on same-signature recompiles "
                                 "(jit-cache thrash) — the CI gate")
+        elif name == "explain":
+            p.add_argument("--check", action="store_true",
+                           help="exit 1 on error-severity data-quality "
+                                "findings — the CI model-quality gate")
     p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
                                      "of per-rank shards")
     p.add_argument("shards", nargs="+",
@@ -424,6 +567,10 @@ def main(argv=None):
             return 1
     elif args.cmd == "stragglers":
         render_stragglers(events)
+    elif args.cmd == "explain":
+        bad = render_explain(events)
+        if args.check and bad:
+            return 1
     elif args.cmd == "diff":
         render_diff(a, b)
     elif args.cmd == "trace":

@@ -36,7 +36,8 @@ from .histogram import (compact_rows, compact_rows_topk, gathered_histogram,
 from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
-                           SPLIT_VEC_SIZE, THRESHOLD, FeatureMeta, SplitParams,
+                           SECOND_FEATURE, SECOND_GAIN, SPLIT_VEC_SIZE,
+                           THRESHOLD, FeatureMeta, SplitParams,
                            find_best_split_impl, per_feature_candidates)
 
 
@@ -73,6 +74,10 @@ class TreeArrays(NamedTuple):
     leaf_value: jnp.ndarray          # (L,) f  (unshrunk outputs)
     leaf_count: jnp.ndarray          # (L,) i32
     leaf_depth: jnp.ndarray          # (L,) i32
+    # split-audit trail: the runner-up feature each split beat and its
+    # gain (-1 / 0 when the winner was the only valid candidate)
+    second_feature: jnp.ndarray      # (L-1,) i32
+    second_gain: jnp.ndarray         # (L-1,) f
 
 
 def feature_hist_view(ghist, sums, meta, bundle, has_bundle: bool,
@@ -374,6 +379,9 @@ def make_grow_core(num_leaves: int, num_bins: int,
         b = find_best_split_impl(hist, sums[0], sums[1], sums[2], local_meta,
                                  local_mask, params)
         b = b.at[FEATURE].add(offset.astype(b.dtype))
+        sf = b[SECOND_FEATURE]
+        b = b.at[SECOND_FEATURE].set(
+            jnp.where(sf >= 0, sf + offset.astype(b.dtype), sf))
         gathered = lax.all_gather(b, feature_axis)      # (n_shards, V)
         # strict-> fold keeps the earlier shard on ties; shards hold
         # contiguous feature blocks, so this IS the smaller-global-feature
@@ -381,7 +389,17 @@ def make_grow_core(num_leaves: int, num_bins: int,
         best = gathered[0]
         for i in range(1, gathered.shape[0]):
             take = gathered[i][GAIN] > best[GAIN]
-            best = jnp.where(take, gathered[i], best)
+            win = jnp.where(take, gathered[i], best)
+            lose = jnp.where(take, best, gathered[i])
+            # merged runner-up: the loser's winning candidate competes
+            # with the winner's own runner-up (both are valid non-winners)
+            loser_valid = jnp.isfinite(lose[GAIN]) & (lose[GAIN] > 0.0)
+            use_loser = loser_valid & (lose[GAIN] > win[SECOND_GAIN])
+            win = win.at[SECOND_GAIN].set(
+                jnp.where(use_loser, lose[GAIN], win[SECOND_GAIN]))
+            win = win.at[SECOND_FEATURE].set(
+                jnp.where(use_loser, lose[FEATURE], win[SECOND_FEATURE]))
+            best = win
         return depth_gate(best, depth)
 
     def best_of_voting(ghist_local, sums, feature_mask, depth, meta,
@@ -418,6 +436,11 @@ def make_grow_core(num_leaves: int, num_bins: int,
                                  sub_meta, feature_mask[sel], params)
         f_local = b[FEATURE].astype(jnp.int32)
         b = b.at[FEATURE].set(sel[f_local].astype(b.dtype))
+        sf_local = b[SECOND_FEATURE].astype(jnp.int32)
+        b = b.at[SECOND_FEATURE].set(
+            jnp.where(sf_local >= 0,
+                      sel[jnp.clip(sf_local, 0, k - 1)].astype(b.dtype),
+                      b[SECOND_FEATURE]))
         return depth_gate(b, depth)
 
     def grow(X, grad, hess, row_mult, feature_mask, meta, bundle):
@@ -502,6 +525,8 @@ def make_grow_core(num_leaves: int, num_bins: int,
             leaf_count=jnp.zeros(L, jnp.int32).at[0].set(
                 root_sums[2].astype(jnp.int32)),
             leaf_depth=jnp.zeros(L, jnp.int32),
+            second_feature=jnp.full(L - 1, -1, jnp.int32),
+            second_gain=jnp.zeros(L - 1, hist_dtype),
         )
 
         def cond(carry):
@@ -705,6 +730,11 @@ def make_grow_core(num_leaves: int, num_bins: int,
                                new_leaf, info[RIGHT_COUNT].astype(jnp.int32)),
                 leaf_depth=upd(upd(tree.leaf_depth, best_leaf, depth),
                                new_leaf, depth),
+                second_feature=upd(tree.second_feature, node,
+                                   info[SECOND_FEATURE].astype(jnp.int32)),
+                second_gain=upd(tree.second_gain, node,
+                                jnp.where(jnp.isfinite(info[SECOND_GAIN]),
+                                          info[SECOND_GAIN], 0.0)),
             )
 
             # ---- children: smaller scanned, larger by subtraction

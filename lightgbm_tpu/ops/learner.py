@@ -852,11 +852,17 @@ def materialize_tree(host_tree: TreeArrays, train_data: TrainingData,
     tree.leaf_value[:nl] = host_tree.leaf_value[:nl]
     tree.leaf_count[:nl] = host_tree.leaf_count[:nl]
     tree.leaf_depth[:nl] = host_tree.leaf_depth[:nl]
+    tree.second_gain[:ni] = host_tree.second_gain[:ni]
     from ..utils.common import avoid_inf
     for i in range(ni):
         inner_f = int(host_tree.split_feature[i])
         mapper = train_data.feature_bin_mapper(inner_f)
         tree.split_feature[i] = train_data.real_feature_index(inner_f)
+        # runner-up candidate resolved to the real feature index (the
+        # split-audit margin surface; -1 = no competitor)
+        sf_inner = int(host_tree.second_feature[i])
+        tree.second_feature[i] = (train_data.real_feature_index(sf_inner)
+                                  if sf_inner >= 0 else -1)
         tree.threshold[i] = avoid_inf(
             mapper.bin_to_value(int(host_tree.threshold_bin[i])))
         dbz = int(host_tree.default_bin_for_zero[i])

@@ -42,7 +42,12 @@ RIGHT_SUM_G = 9
 RIGHT_SUM_H = 10
 RIGHT_COUNT = 11
 IS_CAT = 12
-SPLIT_VEC_SIZE = 13
+# runner-up feature and its gain (split-audit margin: how close the
+# second-best feature came); SECOND_FEATURE is -1 and SECOND_GAIN 0 when
+# no other feature had a valid split
+SECOND_FEATURE = 13
+SECOND_GAIN = 14
+SPLIT_VEC_SIZE = 15
 
 
 class FeatureMeta(NamedTuple):
@@ -270,6 +275,10 @@ def find_best_split_impl(hist, total_g, total_h, total_cnt,
     masked_gain = jnp.where(feature_mask, best.gain, -jnp.inf)
     f = jnp.argmax(masked_gain)          # ties -> smaller feature index
     bgain = masked_gain[f]
+    # runner-up: best gain over the OTHER features (split-audit margin)
+    masked2 = masked_gain.at[f].set(-jnp.inf)
+    f2 = jnp.argmax(masked2)
+    g2 = masked2[f2]
     lg, lh, lc = best.left_g[f], best.left_h[f], best.left_c[f]
     rg = total_g - lg
     rh = total_h_eps - lh
@@ -288,6 +297,9 @@ def find_best_split_impl(hist, total_g, total_h, total_cnt,
         rh - eps,
         rc,
         meta.is_categorical[f].astype(dtype),
+        jnp.where(jnp.isfinite(g2), f2, -1).astype(dtype),
+        jnp.where(jnp.isfinite(g2), g2 - min_gain_shift,
+                  jnp.asarray(0.0, dtype)),
     ])
     # keep -inf gain truly -inf (the subtraction above turns it into nan)
     out = out.at[GAIN].set(jnp.where(jnp.isfinite(bgain),

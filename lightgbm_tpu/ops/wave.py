@@ -45,8 +45,9 @@ from .histogram import leaf_histogram_onehot, leaf_histogram_scatter
 from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            LEFT_COUNT, LEFT_OUTPUT, LEFT_SUM_G, LEFT_SUM_H,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G,
-                           RIGHT_SUM_H, SPLIT_VEC_SIZE, THRESHOLD,
-                           FeatureMeta, SplitParams, find_best_split_impl)
+                           RIGHT_SUM_H, SECOND_FEATURE, SECOND_GAIN,
+                           SPLIT_VEC_SIZE, THRESHOLD, FeatureMeta,
+                           SplitParams, find_best_split_impl)
 
 # modes implemented only as wave-schedule Pallas kernels; every
 # engine/learner gate imports THIS tuple so adding a kernel variant is a
@@ -633,6 +634,8 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
             leaf_count=jnp.zeros(L, jnp.int32).at[0].set(
                 root_sums[2].astype(jnp.int32)),
             leaf_depth=jnp.zeros(L, jnp.int32),
+            second_feature=jnp.full(L - 1, -1, jnp.int32),
+            second_gain=jnp.zeros(L - 1, hist_dtype),
         )
 
         def cond(carry):
@@ -842,6 +845,11 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
                         info[:, RIGHT_COUNT].astype(jnp.int32), mode="drop"),
                 leaf_depth=tree.leaf_depth.at[lsrc3].set(
                     depth, mode="drop").at[rsrc3].set(depth, mode="drop"),
+                second_feature=tree.second_feature.at[nsrc].set(
+                    info[:, SECOND_FEATURE].astype(jnp.int32), mode="drop"),
+                second_gain=tree.second_gain.at[nsrc].set(
+                    jnp.where(jnp.isfinite(info[:, SECOND_GAIN]),
+                              info[:, SECOND_GAIN], 0.0), mode="drop"),
             )
             return (nn + kc, kc == 0, leaf_id, hists, bests, sums, tree)
 

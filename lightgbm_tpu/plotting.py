@@ -22,19 +22,57 @@ def _to_booster(booster):
     raise TypeError("booster must be Booster or LGBMModel.")
 
 
+def _importance_history_from(source, importance_type):
+    """Importance trajectory from any of the supported sources: an obs
+    timeline JSONL path, a list of event dicts (Booster.telemetry()), an
+    importance_history() result, or a Booster/LGBMModel with telemetry.
+    None means 'not a history source' (plain feature_importance plot)."""
+    from .obs.model import importance_history
+    if isinstance(source, str):
+        from .obs.query import last_run, load_timeline
+        return importance_history(last_run(load_timeline(source)),
+                                  importance_type)
+    if isinstance(source, (list, tuple)):
+        src = list(source)
+        if src and isinstance(src[0], dict) and "importance" in src[0]:
+            return src                      # already a history result
+        return importance_history(src, importance_type)
+    if isinstance(source, (Booster, LGBMModel)):
+        hist = _to_booster(source).importance_history(importance_type)
+        return hist or None                 # no events -> snapshot plot
+    return None
+
+
 def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
                     title="Feature importance", xlabel="Feature importance",
                     ylabel="Features", importance_type="split",
                     max_num_features=None, ignore_zero=True, figsize=None,
                     grid=True, **kwargs):
-    """Bar chart of feature importances (plotting.py:18-112)."""
+    """Bar chart of feature importances (plotting.py:18-112).
+
+    ``booster`` may also be an obs timeline path, a telemetry event list,
+    or a ``Booster.importance_history()`` result — the bars then show the
+    final ``importance`` snapshot recorded by ``obs_importance_every``
+    (see plot_importance_history for the trajectory view)."""
     try:
         import matplotlib.pyplot as plt
     except ImportError:
         raise ImportError("You must install matplotlib to plot importance.")
-    booster = _to_booster(booster)
-    importance = booster.feature_importance(importance_type)
-    feature_names = booster.feature_name()
+    if isinstance(booster, (str, list, tuple)):
+        hist = _importance_history_from(booster, importance_type)
+        if not hist:
+            raise ValueError("No importance events in the timeline (train "
+                             "with obs_importance_every=N)")
+        final = hist[-1]["importance"]
+        nf = (max(final) + 1) if final else 0
+        importance = np.zeros(nf)
+        for f, v in final.items():
+            importance[f] = v
+        feature_names = ["Column_%d" % i for i in range(nf)]
+    else:
+        booster = _to_booster(booster)
+        importance = booster.feature_importance(importance_type)
+        feature_names = booster.feature_name()
     tuples = sorted(zip(feature_names, importance), key=lambda x: x[1])
     if ignore_zero:
         tuples = [x for x in tuples if x[1] > 0]
@@ -63,6 +101,52 @@ def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
         ax.set_xlabel(xlabel)
     if ylabel is not None:
         ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_importance_history(source, importance_type="gain", ax=None,
+                            max_num_features=10, xlim=None, ylim=None,
+                            title="Feature importance evolution",
+                            xlabel="Iterations", ylabel="auto",
+                            figsize=None, grid=True, **kwargs):
+    """Per-feature importance trajectories over training iterations.
+
+    ``source``: an obs timeline JSONL path, a telemetry event list, an
+    ``importance_history()`` result, or a Booster trained with
+    ``obs_importance_every=N``.  One line per feature, top
+    ``max_num_features`` by final importance."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance.")
+    hist = _importance_history_from(source, importance_type)
+    if not hist:
+        raise ValueError("No importance events in the source (train with "
+                         "obs_importance_every=N)")
+    final = hist[-1]["importance"]
+    top = sorted(final, key=lambda f: -final[f])
+    if max_num_features is not None and max_num_features > 0:
+        top = top[:max_num_features]
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    its = [h["it"] for h in hist]
+    for f in top:
+        ax.plot(its, [h["importance"].get(f, 0.0) for h in hist],
+                label="Column_%d" % f, **kwargs)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    ax.set_ylabel("%s importance" % importance_type
+                  if ylabel == "auto" else ylabel)
     ax.grid(grid)
     return ax
 

@@ -78,12 +78,14 @@ class Predictor:
 
     def __init__(self, gbdt: GBDT, num_iteration: int = -1,
                  raw_score: bool = False, predict_leaf_index: bool = False,
+                 pred_contrib: bool = False,
                  early_stop: bool = False, early_stop_freq: int = 10,
                  early_stop_margin: float = 10.0):
         self.gbdt = gbdt
         self.num_iteration = num_iteration
         self.raw_score = raw_score
         self.predict_leaf_index = predict_leaf_index
+        self.pred_contrib = pred_contrib
         k = gbdt.num_tree_per_iteration
         if early_stop and not predict_leaf_index:
             kind = "multiclass" if k > 1 else "binary"
@@ -108,6 +110,11 @@ class Predictor:
             features = features.reshape(1, -1)
         if self.predict_leaf_index:
             return self.gbdt.predict_leaf_index(features, self.num_iteration)
+        if self.pred_contrib:
+            # attribution debug path (host, f64): gain-weighted per-feature
+            # contributions; early stopping does not apply — the whole
+            # point is seeing every tree's share
+            return self.gbdt.pred_contrib(features, self.num_iteration)
         gbdt = self.gbdt
         gbdt._materialize()
         k = gbdt.num_tree_per_iteration

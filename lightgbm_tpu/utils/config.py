@@ -110,6 +110,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_watchdog": "obs_watchdog_secs",
     "obs_events_fsync": "obs_fsync",
     "obs_ring_events": "obs_flight_events",
+    "obs_audit": "obs_split_audit",
+    "obs_audit_splits": "obs_split_audit",
+    "obs_importance_freq": "obs_importance_every",
+    "obs_importance_k": "obs_importance_topk",
+    "obs_profile_data": "obs_data_profile",
+    "obs_dataset_profile": "obs_data_profile",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -160,6 +166,8 @@ PARAMETER_SET = {
     "obs_metrics_path", "obs_metrics_every",
     "obs_compile", "obs_straggler_every", "obs_straggler_warn_skew",
     "obs_watchdog_secs", "obs_fsync", "obs_flight_events",
+    "obs_split_audit", "obs_importance_every", "obs_importance_topk",
+    "obs_data_profile",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -527,6 +535,26 @@ class Config:
         # size of the in-memory event ring buffer the flight record
         # snapshots (last N events this rank emitted)
         "obs_flight_events": ("int", 256),
+        # split audit trail (obs/model.py): emit a `split_audit` event
+        # per tree recording every realized split's feature, bin/real
+        # threshold, gain, child counts, and the runner-up feature +
+        # gain margin from the split search.  Turns the observer on.
+        "obs_split_audit": ("bool", False),
+        # emit a top-k sparse `importance` event (cumulative split/gain
+        # feature importance) every N iterations (0 = off).  Turns the
+        # observer on; read back via Booster.importance_history() /
+        # `obs explain` / plotting.plot_importance.
+        "obs_importance_every": ("int", 0),
+        # how many features each `importance` event keeps (top-k by
+        # gain, ties to the smaller feature index)
+        "obs_importance_topk": ("int", 20),
+        # emit a `data_profile` event at training start (per-feature
+        # missing rate, bin-occupancy entropy, constant / near-constant
+        # / high-cardinality-categorical flags, label balance) whenever
+        # the observer is enabled; degenerate findings route through the
+        # obs_health channel (warn logs, fatal aborts naming the
+        # feature).  Does NOT enable the observer by itself.
+        "obs_data_profile": ("bool", True),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -38,9 +38,12 @@ def test_bench_orchestrator_end_to_end():
              if ln.startswith("{")]
     assert len(lines) == 1, r.stdout
     rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "final_eval_metric", "final_eval_name"}
     assert rec["value"] > 0
     assert rec["unit"] == "iters/sec"
+    assert rec["final_eval_name"] == "auc"
+    assert 0.0 < rec["final_eval_metric"] <= 1.0
     # an overridden shape must not masquerade as the flagship artifact
     assert "higgs20000x28" in rec["metric"]
     assert rec["vs_baseline"] is None

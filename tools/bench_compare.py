@@ -26,7 +26,7 @@ artifact are reported and skipped; no overlap at all is a usage error.
 Usage:
     python tools/bench_compare.py BASELINE CANDIDATE \
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
-        [--tol-recompile 0] [--json]
+        [--tol-recompile 0] [--tol-eval 0.02] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -54,6 +54,11 @@ METRICS = {
     # multi-rank timelines only — `obs merge` output); a growing skew
     # means a rank got slower relative to its peers
     "barrier_skew_max_s": (-1, 0.50),
+    # model quality next to the perf numbers: the last `eval` event's
+    # metric (bench --child records it as final_eval_metric).  Assumes a
+    # higher-is-better metric (auc — the bench protocol's); a perf win
+    # that costs more than 2% quality is a regression, not a win
+    "final_eval_metric": (+1, 0.02),
 }
 
 
@@ -99,6 +104,12 @@ def _from_timeline(events):
              if e.get("ev") == "host_collective" and "skew_s" in e]
     if skews:
         out["barrier_skew_max_s"] = max(skews)
+    # final model quality: the LAST eval event's first result (schema v5;
+    # runs without metrics simply skip the gate)
+    evals = [e for e in events if e.get("ev") == "eval"
+             and e.get("results")]
+    if evals:
+        out["final_eval_metric"] = float(evals[-1]["results"][-1]["value"])
     return out
 
 
@@ -111,6 +122,8 @@ def _from_parsed(parsed):
     if "iters/sec" in unit or "iters_per_sec" in str(parsed.get("metric",
                                                                 "")):
         out["iters_per_sec"] = float(value)
+    if parsed.get("final_eval_metric") is not None:
+        out["final_eval_metric"] = float(parsed["final_eval_metric"])
     return out
 
 
@@ -198,12 +211,16 @@ def main(argv=None):
         "recompile_count"][1],
         help="recompile-count relative tolerance (0 = any new "
              "recompile vs a clean baseline fails)")
+    ap.add_argument("--tol-eval", type=float, default=METRICS[
+        "final_eval_metric"][1],
+        help="final eval-metric relative tolerance (higher-is-better)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
     tols = {"iters_per_sec": args.tol_ips, "compile_s": args.tol_compile,
             "peak_mem_bytes": args.tol_mem,
-            "recompile_count": args.tol_recompile}
+            "recompile_count": args.tol_recompile,
+            "final_eval_metric": args.tol_eval}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

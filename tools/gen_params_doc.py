@@ -159,6 +159,26 @@ NOTES = {
                                "when a straggler sample's skew — "
                                "(max-median)/total per-shard wait — "
                                "exceeds this fraction",
+    "obs_watchdog_secs": "hang watchdog: dump a flight record after N "
+                         "seconds without training progress (0 = off)",
+    "obs_fsync": "os.fsync the timeline shard on run_end",
+    "obs_flight_events": "event ring-buffer capacity snapshotted into "
+                         "flight records",
+    "obs_split_audit": "record every realized split per tree as "
+                       "split_audit events: feature, bin/threshold, "
+                       "gain, child counts, and the runner-up "
+                       "feature + gain margin",
+    "obs_importance_every": "emit top-k sparse split/gain importance "
+                            "events every N iterations (0 = off) — the "
+                            "trajectory behind Booster."
+                            "importance_history()",
+    "obs_importance_topk": "features kept per importance event "
+                           "(<=0 = all used features)",
+    "obs_data_profile": "profile the binning sample at Dataset "
+                        "construction (missing rates, bin-occupancy "
+                        "entropy, constant/near-constant/ID-like "
+                        "flags, label balance) into a data_profile "
+                        "event; findings route through obs_health",
 }
 
 GROUPS = [
@@ -206,7 +226,9 @@ GROUPS = [
         "obs_health", "obs_health_every", "obs_health_divergence",
         "obs_health_plateau", "obs_health_mem_frac", "obs_metrics_path",
         "obs_metrics_every", "obs_compile", "obs_straggler_every",
-        "obs_straggler_warn_skew"]),
+        "obs_straggler_warn_skew", "obs_watchdog_secs", "obs_fsync",
+        "obs_flight_events", "obs_split_audit", "obs_importance_every",
+        "obs_importance_topk", "obs_data_profile"]),
 ]
 
 
