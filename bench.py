@@ -443,7 +443,24 @@ def dry():
               "obs_health": "warn", "obs_metrics_every": 2,
               "obs_compile": True, "obs_split_audit": True,
               "obs_importance_every": 2}
-    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    # bucketed device predict: varying batch sizes must land on the
+    # power-of-two executables (models/gbdt.py dispatch) — after one
+    # predict per bucket rung, further novel sizes may not compile
+    from lightgbm_tpu.ops.predict import ranked_predict_device
+    bst._gbdt.config.tpu_predict = "true"
+    full = bst.predict(X)
+    for n in (100, 300, 600, 1200, 2000):       # rungs 256..2048
+        assert np.array_equal(bst.predict(X[:n]), full[:n]), \
+            "bucketed predict diverged at n=%d" % n
+    warm_entries = ranked_predict_device._cache_size()
+    for n in (7, 130, 257, 999, 1500, 1999):
+        bst.predict(X[:n])
+    assert ranked_predict_device._cache_size() == warm_entries, \
+        "steady-state predict recompiled: %d jit entries after warmup " \
+        "covered every bucket rung, %d after mixed-size traffic" \
+        % (warm_entries, ranked_predict_device._cache_size())
 
     evs = read_events(obs_path)          # validates every record
     kinds = [e["ev"] for e in evs]

@@ -1,0 +1,204 @@
+"""Serving benchmark: latency distribution + sustained QPS of the serve tier.
+
+Drives ``Booster.serve()`` (lightgbm_tpu/serve) with a closed-loop load
+generator — N submitter threads, each firing mixed-size requests and
+waiting for its future — and reports p50/p99 request latency and
+sustained queries/sec.  The numbers land in an obs JSONL timeline as a
+``serve_bench`` event (next to the ``compile_attr`` and sampled
+``serve_batch`` events the serve tier emits), so ``tools/bench_compare.py``
+can gate ``serve_qps`` / ``serve_p99_s`` between runs and ``obs
+recompiles --check`` can assert the steady state compiled nothing.
+
+Prints ONE JSON line:
+    {"metric", "value", "unit", "serve_qps", "serve_p50_s", "serve_p99_s",
+     "requests", "path"}
+
+``--dry`` is the CI smoke (JAX_PLATFORMS=cpu): a tiny model, a short
+mixed-size burst, then hard asserts — schema-valid timeline, zero
+steady-state compiles, every ``compile_attr`` entry compiled exactly
+once, and serve output matching ``Booster.predict``.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def build_model(rows, features, leaves, rounds):
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(rows, features)).astype(np.float32)
+    w = rng.normal(size=features)
+    y = (X @ w > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, np.asarray(X, np.float64)
+
+
+def run_load(sp, X, requests, threads, sizes, seed=5):
+    """Closed-loop load: each thread submits ``requests // threads``
+    mixed-size blocks and waits for each future.  Returns (latencies,
+    wall_s, rows_scored)."""
+    lat = [[] for _ in range(threads)]
+    rows = [0] * threads
+    per = max(requests // threads, 1)
+
+    def worker(i):
+        rng = np.random.default_rng(seed + i)
+        for _ in range(per):
+            n = int(rng.choice(sizes))
+            lo = int(rng.integers(0, max(X.shape[0] - n, 1)))
+            t0 = time.perf_counter()
+            sp.submit(X[lo:lo + n]).result()
+            lat[i].append(time.perf_counter() - t0)
+            rows[i] += n
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    return np.concatenate([np.asarray(x) for x in lat]), wall, sum(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serving-tier load benchmark (p50/p99 latency, QPS)")
+    ap.add_argument("--dry", action="store_true",
+                    help="CI smoke: tiny shape + hard telemetry asserts")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="training rows (default 4000 dry / 200000 full)")
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--leaves", type=int, default=None)
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total requests (default 400 dry / 5000 full)")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=4096)
+    ap.add_argument("--obs-path", default=None,
+                    help="serve timeline path (default /tmp/bench_serve_"
+                         "obs_<pid>.jsonl)")
+    args = ap.parse_args(argv)
+
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
+
+    rows = args.rows or (4000 if args.dry else 200_000)
+    leaves = args.leaves or (15 if args.dry else 255)
+    rounds = args.rounds or (10 if args.dry else 100)
+    requests = args.requests or (400 if args.dry else 5000)
+    obs_path = args.obs_path or ("/tmp/bench_serve_obs_%d.jsonl"
+                                 % os.getpid())
+    try:
+        os.unlink(obs_path)
+    except OSError:
+        pass
+
+    bst, X = build_model(rows, args.features, leaves, rounds)
+
+    # the serve run gets its OWN timeline (training closes its observer
+    # when lgb.train returns): compile attribution lands here so `obs
+    # recompiles --check` sees the per-bucket serve entries, plus a
+    # sampled serve_batch trail for postmortems
+    import jax
+    from lightgbm_tpu.obs import RunObserver
+    obs = RunObserver(events_path=obs_path, compile_attr=True)
+    obs.run_header(backend=jax.default_backend(),
+                   devices=[str(d) for d in jax.local_devices()],
+                   params={"requests": requests, "threads": args.threads,
+                           "max_delay_ms": args.max_delay_ms,
+                           "max_batch": args.max_batch},
+                   context={"tool": "bench_serve"})
+
+    # request-size mix: singletons up to full buckets, so the deadline
+    # flush, padding, and every bucket rung all see traffic
+    sizes = [1, 3, 16, 50, 120, 400] if args.dry else \
+            [1, 8, 32, 100, 256, 512, 1024]
+    with bst.serve(max_delay_ms=args.max_delay_ms,
+                   max_batch=args.max_batch, observer=obs,
+                   batch_event_every=8) as sp:
+        # warm the FULL rung ladder (coalesced batches can land on any
+        # bucket up to max_batch), then mark warm: any later compile is
+        # a steady-state violation
+        buckets = []
+        if sp.cache is not None:
+            rungs, b = [], sp.cache.bucket_min
+            while b < sp.cache.max_batch:
+                rungs.append(b)
+                b <<= 1
+            rungs.append(sp.cache.max_batch)
+            buckets = sp.cache.warmup(rungs)
+            sp.cache.mark_warm()
+        lat, wall, nrows = run_load(sp, X, requests, args.threads, sizes)
+        stats = sp.stats()
+    qps = len(lat) / wall
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+    ssc = (stats.get("executables") or {}).get("steady_state_compiles")
+
+    obs.event("serve_bench", qps=round(qps, 3),
+              p50_s=round(p50, 6), p99_s=round(p99, 6),
+              requests=len(lat), rows=int(nrows),
+              rows_per_s=round(nrows / wall, 1),
+              threads=args.threads, wall_s=round(wall, 3),
+              batches=stats["batches"], pad_rows=stats["pad_rows"],
+              buckets=buckets,
+              steady_state_compiles=ssc)
+    obs.close()
+
+    if args.dry:
+        _dry_asserts(bst, X, obs_path, ssc)
+
+    print(json.dumps({
+        "metric": "serve_qps_mixed%dthreads" % args.threads,
+        "value": round(qps, 3), "unit": "req/s",
+        "serve_qps": round(qps, 3),
+        "serve_p50_s": round(p50, 6), "serve_p99_s": round(p99, 6),
+        "requests": len(lat), "rows": int(nrows),
+        "steady_state_compiles": ssc,
+        "path": obs_path,
+    }))
+
+
+def _dry_asserts(bst, X, obs_path, steady_state_compiles):
+    """The CI gates: parseable timeline, the serve event trail present,
+    zero steady-state compiles, and correct predictions."""
+    from lightgbm_tpu.obs import read_events
+    evs = read_events(obs_path)          # validates every record
+    kinds = {e["ev"] for e in evs}
+    for need in ("run_header", "compile", "compile_attr", "serve_batch",
+                 "serve_bench", "run_end"):
+        assert need in kinds, "serve timeline missing %r events" % need
+    serve_attr = [e for e in evs if e["ev"] == "compile_attr"
+                  and str(e.get("entry", "")).startswith("serve_predict")]
+    assert serve_attr, "no serve compile_attr entries recorded"
+    thrash = [e for e in serve_attr if e.get("sig_compiles", 1) > 1
+              or e.get("n_compiles", 1) > 1]
+    assert not thrash, "serve entry recompiled: %r" % thrash
+    assert steady_state_compiles == 0, \
+        "steady state compiled %r executables" % steady_state_compiles
+    sb = [e for e in evs if e["ev"] == "serve_bench"][-1]
+    assert sb["qps"] > 0 and sb["p99_s"] >= sb["p50_s"] > 0
+    # correctness probe: the serve path must match Booster.predict
+    with bst.serve(max_delay_ms=0.5) as sp:
+        got = sp.predict(X[:100])
+    want = bst.predict(X[:100])
+    assert np.allclose(got, want, rtol=2e-6, atol=1e-7), \
+        "serve prediction diverged from Booster.predict"
+    print(json.dumps({"status": "serve_dry_ok", "events": len(evs),
+                      "serve_compiles": len(serve_attr)}),
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

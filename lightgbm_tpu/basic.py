@@ -11,6 +11,7 @@ round-trip, continued training via ``init_model``.
 from __future__ import annotations
 
 import copy as _copy
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -20,6 +21,7 @@ from .metrics import create_metric
 from .models.gbdt import GBDT
 from .models.factory import create_boosting
 from .objectives import create_objective
+from .obs.metrics import observe_predict
 from .utils.config import Config, param_dict_to_str
 from .utils.log import LightGBMError, Log
 
@@ -735,18 +737,37 @@ class Booster:
         if isinstance(data, Dataset):
             raise TypeError("Cannot use Dataset instance for prediction, "
                             "please use raw data instead")
-        import time as _time
-        from .obs.metrics import observe_predict
         t0 = _time.perf_counter()
-        out = self._predict_data(data, num_iteration, raw_score, pred_leaf,
-                                 pred_contrib, data_has_header)
-        observe_predict(np.asarray(out).shape[0] if np.ndim(out) else 1,
-                        _time.perf_counter() - t0)
+        out, rows = self._predict_data(data, num_iteration, raw_score,
+                                       pred_leaf, pred_contrib,
+                                       data_has_header, pred_early_stop,
+                                       pred_early_stop_freq,
+                                       pred_early_stop_margin)
+        # rows counted from the INPUT blocks (1-D converted outputs and
+        # (n, k) multiclass matrices both count n rows)
+        observe_predict(rows, _time.perf_counter() - t0)
         return out
 
     def _predict_data(self, data, num_iteration, raw_score, pred_leaf,
-                      pred_contrib, data_has_header):
+                      pred_contrib, data_has_header,
+                      pred_early_stop=False, pred_early_stop_freq=10,
+                      pred_early_stop_margin=10.0):
+        """-> (predictions, input row count)."""
+        early_predictor = None
+        if pred_early_stop and not (pred_leaf or pred_contrib):
+            # margin-based prediction early stopping (predictor.hpp):
+            # the tree-major loop drops rows whose margin cleared the
+            # threshold — approximate by design, like the reference
+            from .predictor import Predictor
+            early_predictor = Predictor(
+                self._gbdt, num_iteration=num_iteration,
+                raw_score=raw_score, early_stop=True,
+                early_stop_freq=pred_early_stop_freq,
+                early_stop_margin=pred_early_stop_margin)
+
         def run(block):
+            if early_predictor is not None:
+                return early_predictor._predict_impl(block)
             if pred_contrib:
                 return self._gbdt.pred_contrib(block,
                                                num_iteration=num_iteration)
@@ -767,14 +788,44 @@ class Booster:
             if isinstance(mat, SparseColumns):
                 # bounded-memory sparse prediction: densify row chunks
                 # (tree traversal wants raw values, O(chunk * F) at a time)
-                outs = [run(block)
-                        for _, block in iter_dense_row_chunks(mat)]
-                return (np.concatenate(outs) if outs
-                        else np.zeros(0, dtype=np.float64))
+                rows = 0
+                outs = []
+                for _, block in iter_dense_row_chunks(mat):
+                    rows += block.shape[0]
+                    outs.append(run(block))
+                return ((np.concatenate(outs) if outs
+                         else np.zeros(0, dtype=np.float64)), rows)
             mat = np.asarray(mat, dtype=np.float64)
             if mat.ndim == 1:
                 mat = mat.reshape(1, -1)
-        return run(mat)
+        return run(mat), mat.shape[0]
+
+    def serve(self, num_iteration: int = -1, **overrides):
+        """Build a ``ServingPredictor`` for this model — the production
+        predict front end (docs/Serving.md).
+
+        Concurrent callers ``submit()`` feature rows and get futures;
+        requests coalesce into padded power-of-two batches that run
+        through AOT-compiled per-bucket executables (zero steady-state
+        recompiles), with ``pred_early_stop`` / ``pred_contrib`` served
+        from the same queue.  Configured from the booster's ``serve_*``
+        parameters (docs/Parameters.md); keyword ``overrides`` take
+        precedence (``max_batch``, ``max_delay_ms``, ``bucket_min``,
+        ``donate``, ``batch_event_every``, ``num_features``,
+        ``devices``).  Close it (or use as a context manager) to flush
+        the queue and stop the worker thread.
+        """
+        from .serve import ServingPredictor
+        cfg = self._cfg
+        kw = {"max_batch": cfg.serve_max_batch,
+              "max_delay_ms": cfg.serve_max_delay_ms,
+              "bucket_min": cfg.serve_bucket_min,
+              "donate": cfg.serve_donate,
+              "batch_event_every": cfg.serve_batch_event_every,
+              "observer": self._gbdt._obs}
+        kw.update(overrides)
+        return ServingPredictor(self._gbdt, num_iteration=num_iteration,
+                                **kw)
 
     # ------------------------------------------------------------ model I/O
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":

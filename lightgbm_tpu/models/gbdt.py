@@ -855,14 +855,25 @@ class GBDT:
         def dispatch(part):
             """Async: device call issued, nothing blocked on."""
             V, D = dev_predict.rank_encode(rp, part)
+            n = len(part)
+            # power-of-two row bucketing (floor 256, capped at the chunk
+            # size): the jit cache keys on shape, so varying batch sizes
+            # would otherwise each compile a fresh executable — padded
+            # rows are sliced off in drain()
+            bucket = min(1 << max(int(n - 1).bit_length(), 8), chunk)
+            if bucket > n:
+                V = np.concatenate(
+                    [V, np.zeros((bucket - n, V.shape[1]), V.dtype)])
+                D = np.concatenate(
+                    [D, np.zeros((bucket - n, D.shape[1]), D.dtype)])
             if len(devices) > 1:
                 # rows shard over the device mesh; trees replicate —
                 # bit-identical to single-device (pure data parallel)
-                score, nrows = dev_predict.ranked_predict_sharded(
+                score, _ = dev_predict.ranked_predict_sharded(
                     rp, V, D, k, devices=devices)
-                return score, nrows
+                return score, n
             return dev_predict.ranked_predict_device(
-                rp.dev, jnp.asarray(V), jnp.asarray(D), k), len(part)
+                rp.dev, jnp.asarray(V), jnp.asarray(D), k), n
 
         def drain(pending):
             plo, pscore, pnrows = pending

@@ -13,13 +13,25 @@ Decision semantics (tree.h:229-276):
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
 from ..utils.common import (array_to_string, avoid_inf, kMaxTreeOutput,
                             kMissingValueRange, parse_kv_lines, string_to_array)
 from ..utils.log import Log
+
+
+class NodeArrays(NamedTuple):
+    """Per-internal-node SoA views of one tree, trimmed to the realized
+    node count (Tree.node_arrays) — the unit the stacked device predictor
+    packs without per-node Python loops."""
+    split_feature: np.ndarray   # (ni,) i32 real (outer) feature index
+    threshold: np.ndarray       # (ni,) f64
+    decision_type: np.ndarray   # (ni,) i8 (1 = categorical)
+    default_value: np.ndarray   # (ni,) f64 zero-range replacement value
+    left_child: np.ndarray      # (ni,) i32 (~leaf for leaves)
+    right_child: np.ndarray     # (ni,) i32
 
 
 class Tree:
@@ -110,6 +122,20 @@ class Tree:
 
     def set_leaf_value(self, leaf: int, value: float) -> None:
         self.leaf_value[leaf] = value
+
+    def node_arrays(self) -> "NodeArrays":
+        """Trimmed per-internal-node views (num_leaves - 1 entries) for
+        bulk packing into stacked device tree arrays (ops/predict.py
+        build_ranked_predictor).  Views, not copies — callers must not
+        mutate."""
+        ni = max(self.num_leaves - 1, 0)
+        return NodeArrays(
+            split_feature=self.split_feature[:ni],
+            threshold=self.threshold[:ni],
+            decision_type=self.decision_type[:ni],
+            default_value=self.default_value[:ni],
+            left_child=self.left_child[:ni],
+            right_child=self.right_child[:ni])
 
     # -------------------------------------------------------------- predict
     def predict(self, features: np.ndarray) -> np.ndarray:

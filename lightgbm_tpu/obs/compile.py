@@ -34,6 +34,8 @@ to a broken training run.
 """
 from __future__ import annotations
 
+import weakref
+
 from ..utils.log import Log
 
 # default labels for the top-level positions of a registered entry call
@@ -234,11 +236,18 @@ class CompileTracker:
         fn, args, sig, cache0 = pending
         st = self._entries.setdefault(name, {
             "calls": 0, "compiles": 0, "sig_compiles": {},
-            "last_compiled_sig": None, "fn_id": None})
+            "last_compiled_sig": None, "fn_ref": None})
         st["calls"] += 1
         cache1 = _cache_size(fn)
-        rebuilt = st["fn_id"] is not None and st["fn_id"] != id(fn)
-        st["fn_id"] = id(fn)
+        # identity via weakref, not id(): a GC'd program can hand its id
+        # to the replacement, masking the rebuild — and a dead ref IS a
+        # rebuild (the old program object is gone)
+        prev = st["fn_ref"]
+        rebuilt = prev is not None and prev() is not fn
+        try:
+            st["fn_ref"] = weakref.ref(fn)
+        except TypeError:                  # non-weakrefable callable
+            st["fn_ref"] = (lambda obj: (lambda: obj))(fn)
         if cache0 is not None and cache1 is not None:
             compiled = cache1 > cache0
         else:

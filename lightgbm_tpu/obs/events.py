@@ -30,6 +30,10 @@ data_profile   n_features (schema 5; obs/dataquality.py — per-feature
                missing rate / entropy / degeneracy flags, label balance)
 eval           it, results (schema 5; per-iteration eval-metric values,
                the convergence surface `obs explain` reads)
+serve_batch    route, rows, bucket (schema 6; serve/scheduler.py — one
+               coalesced microbatch: queue wait, execute time, pad rows)
+serve_bench    qps, p50_s, p99_s (schema 6; bench_serve.py — sustained
+               load-generator summary, the gated serving metrics)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -65,11 +69,11 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
-# 3 (rank-less, no host_collective) and 4 (no model/data events)
-# timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5)
+# 3 (rank-less, no host_collective), 4 (no model/data events) and
+# 5 (no serving events) timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -95,6 +99,11 @@ _REQUIRED = {
     "importance": ("it", "features"),
     "data_profile": ("n_features",),
     "eval": ("it", "results"),
+    # schema 6 (lightgbm_tpu/serve/): the serving tier — one coalesced
+    # microbatch per serve_batch (sampled via serve_batch_event_every),
+    # one serve_bench summary per bench_serve.py measurement window
+    "serve_batch": ("route", "rows", "bucket"),
+    "serve_bench": ("qps", "p50_s", "p99_s"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 

@@ -278,7 +278,10 @@ def observe_predict(rows, seconds):
     """Serving-path instrumentation: one call per predict request.
     Unconditional (no observer gate) — three lock/adds per request is
     noise next to a traversal, and the serving path has no training-run
-    observer to gate on."""
+    observer to gate on.  ``rows`` is the INPUT row count of the request
+    (the caller computes it from the normalized feature matrix, not the
+    output array, so 1-D converted outputs and multiclass matrices both
+    count rows)."""
     REGISTRY.histogram(
         "lgbm_predict_seconds",
         "per-request predict latency (seconds)").observe(seconds)
@@ -287,3 +290,37 @@ def observe_predict(rows, seconds):
         "rows per predict request", buckets=SIZE_BUCKETS).observe(rows)
     REGISTRY.counter(
         "lgbm_predict_rows_total", "total rows predicted").inc(int(rows))
+
+
+def observe_serve_batch(route, rows, pad, bucket, queue_s, exec_s):
+    """One coalesced serving microbatch (serve/scheduler.py flush):
+    ``rows`` real rows, ``pad`` padding rows added to reach ``bucket``,
+    ``queue_s`` the oldest request's coalescing wait, ``exec_s`` the
+    encode+execute+split time."""
+    REGISTRY.counter(
+        "lgbm_serve_batches_total",
+        "coalesced serving microbatches executed",
+        labels={"route": str(route)}).inc()
+    REGISTRY.counter(
+        "lgbm_serve_rows_total", "rows scored by the serving tier").inc(
+            int(rows))
+    REGISTRY.counter(
+        "lgbm_serve_pad_rows_total",
+        "bucket-padding rows scored and discarded").inc(int(pad))
+    REGISTRY.histogram(
+        "lgbm_serve_batch_rows", "real rows per serving microbatch",
+        buckets=SIZE_BUCKETS).observe(rows)
+    REGISTRY.histogram(
+        "lgbm_serve_queue_seconds",
+        "coalescing wait of the oldest request in a microbatch").observe(
+            queue_s)
+    REGISTRY.histogram(
+        "lgbm_serve_exec_seconds",
+        "microbatch encode+execute+split time").observe(exec_s)
+
+
+def observe_serve_request(seconds):
+    """End-to-end latency of one serving request (submit -> result)."""
+    REGISTRY.histogram(
+        "lgbm_serve_request_seconds",
+        "per-request serving latency, submit to result").observe(seconds)

@@ -105,11 +105,17 @@ def feature_hist_view(ghist, sums, meta, bundle, has_bundle: bool,
 
 def pvary_for(x, axis: str):
     """Mark x shard-varying over `axis` under shard_map (VMA rules),
-    across jax versions (pcast is the newer spelling of pvary)."""
+    across jax versions (pcast is the newer spelling of pvary).  jax
+    lines old enough to have neither primitive predate the VMA checker
+    entirely, so the cast is a no-op there."""
     try:
         return lax.pcast(x, (axis,), to="varying")
     except (AttributeError, TypeError):
+        pass
+    try:
         return lax.pvary(x, (axis,))
+    except AttributeError:
+        return x
 
 
 def default_row_capacities(n: int, min_capacity: int = 2048,

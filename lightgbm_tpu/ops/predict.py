@@ -270,7 +270,12 @@ def build_ranked_predictor(models, num_class: int,
                            num_features: int) -> "RankedPredictor":
     """Pack host Trees into stacked device arrays + per-feature rank
     tables.  Raises ValueError when a feature is used both numerically
-    and categorically (callers fall back to the host path)."""
+    and categorically (callers fall back to the host path).
+
+    All per-node work is vectorized over Tree.node_arrays views — the
+    build is O(nodes) numpy, not O(nodes) interpreted Python, which is
+    what makes cold-start of the serving tier (serve/executable.py) a
+    few ms for 100-tree/255-leaf models instead of seconds."""
     import numpy as np
 
     T = len(models)
@@ -284,55 +289,64 @@ def build_ranked_predictor(models, num_class: int,
     right = np.full((T, M), -1, np.int32)
     leaf_value = np.zeros((T, L), np.float64)
     num_leaves = np.zeros(T, np.int32)
-    per_feature = {}
-    cat_features = set()
-    num_features_used = set()
+    valid = np.zeros((T, M), bool)           # realized internal nodes
     for t, tree in enumerate(models):
-        ni = max(tree.num_leaves - 1, 0)
-        num_leaves[t] = tree.num_leaves
-        leaf_value[t, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+        nl = tree.num_leaves
+        ni = max(nl - 1, 0)
+        num_leaves[t] = nl
+        leaf_value[t, :nl] = tree.leaf_value[:nl]
         if ni == 0:
             continue
-        feat[t, :ni] = tree.split_feature[:ni]
-        thr_raw[t, :ni] = tree.threshold[:ni]
-        is_cat[t, :ni] = (tree.decision_type[:ni] == 1)
-        left[t, :ni] = tree.left_child[:ni]
-        right[t, :ni] = tree.right_child[:ni]
-        for nd in range(ni):
-            f = int(tree.split_feature[nd])
-            th = float(tree.threshold[nd])
-            dv = float(tree.default_value[nd])
-            if tree.decision_type[nd] == 1:
-                cat_features.add(f)
-                if abs(np.int64(th)) > 2 ** 31 - 2:
-                    # the device compares int32; an out-of-domain cat
-                    # threshold cannot be encoded without breaking the
-                    # bit-equal routing contract -> host path
-                    raise ValueError(
-                        "categorical threshold %r exceeds int32" % th)
-                dleft[t, nd] = int(np.int64(dv) == np.int64(th))
-            else:
-                num_features_used.add(f)
-                per_feature.setdefault(f, set()).add(th)
-                dleft[t, nd] = int(dv <= th)
+        na = tree.node_arrays()
+        valid[t, :ni] = True
+        feat[t, :ni] = na.split_feature
+        thr_raw[t, :ni] = na.threshold
+        cat = na.decision_type == 1
+        is_cat[t, :ni] = cat
+        left[t, :ni] = na.left_child
+        right[t, :ni] = na.right_child
+        # the zero-range default decision per node, host-computable once:
+        # numerical `dv <= th`; categorical `int64(dv) == int64(th)` —
+        # cast only the cat nodes (a numeric default can be 1e300, whose
+        # int cast is undefined)
+        with np.errstate(invalid="ignore"):
+            dl = na.default_value <= na.threshold
+        if cat.any():
+            th_i = na.threshold[cat].astype(np.int64)
+            if np.abs(th_i).max() > 2 ** 31 - 2:
+                # the device compares int32; an out-of-domain cat
+                # threshold cannot be encoded without breaking the
+                # bit-equal routing contract -> host path
+                raise ValueError(
+                    "categorical threshold %r exceeds int32"
+                    % float(na.threshold[cat][
+                        int(np.abs(th_i).argmax())]))
+            dl = dl.copy()
+            dl[cat] = na.default_value[cat].astype(np.int64) == th_i
+        dleft[t, :ni] = dl
+    cat_features = frozenset(np.unique(feat[valid & (is_cat > 0)]).tolist())
+    num_mask = valid & (is_cat == 0)
+    num_features_used = frozenset(np.unique(feat[num_mask]).tolist())
     mixed = cat_features & num_features_used
     if mixed:
         raise ValueError("features used both ways: %s" % sorted(mixed))
 
-    thresholds = []
+    # per-feature sorted-unique numerical thresholds, then every numeric
+    # node's rank in its feature's table — grouped searchsorted per used
+    # feature instead of a Python loop over nodes
+    thresholds = [np.empty(0, np.float64)] * max(num_features, 0)
     thr_rank = np.zeros((T, M), np.int32)
-    for f in range(num_features):
-        arr = np.array(sorted(per_feature.get(f, ())), np.float64)
-        thresholds.append(arr)
-    for t, tree in enumerate(models):
-        ni = max(tree.num_leaves - 1, 0)
-        for nd in range(ni):
-            f = int(feat[t, nd])
-            if is_cat[t, nd]:
-                thr_rank[t, nd] = int(np.int64(thr_raw[t, nd]))
-            else:
-                thr_rank[t, nd] = int(np.searchsorted(
-                    thresholds[f], thr_raw[t, nd], side="left"))
+    for f in sorted(num_features_used):
+        nodes_f = num_mask & (feat == f)
+        arr = np.unique(thr_raw[nodes_f])
+        if 0 <= f < num_features:
+            thresholds[f] = arr
+        thr_rank[nodes_f] = np.searchsorted(
+            arr, thr_raw[nodes_f], side="left").astype(np.int32)
+    cat_mask = valid & (is_cat > 0)
+    if cat_mask.any():
+        thr_rank[cat_mask] = thr_raw[cat_mask].astype(np.int64).astype(
+            np.int32)
 
     tree_class = (jnp.arange(T, dtype=jnp.int32) % max(num_class, 1))
     dev = RankedTrees(
@@ -476,10 +490,15 @@ def _sharded_predict_ctx(rp: "RankedPredictor", num_class: int, devices):
         return _ranked_predict_impl(dev_, V_, D_, num_class,
                                     vary_axis=DATA_AXIS)
 
+    # jax lines without pcast/pvary have no replication rule for the
+    # traversal while_loop either — the checker cannot run there, and
+    # the unchecked form is safe (outputs are row-sharded by
+    # construction, no cross-shard reductions anywhere)
+    checked = hasattr(lax, "pcast") or hasattr(lax, "pvary")
     fn = jax.jit(_shard_map_compat(
         _local, mesh,
         in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        out_specs=P(DATA_AXIS, None)))
+        out_specs=P(DATA_AXIS, None), checked=checked))
     ctx = (rows_sh, dev_repl, fn)
     rp._shard_ctx = (key, ctx)
     return ctx

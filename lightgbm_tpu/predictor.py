@@ -7,11 +7,14 @@ src/boosting/prediction_early_stop.cpp — margin-based stop callbacks
 """
 from __future__ import annotations
 
+import time
+
 from typing import Callable, Optional
 
 import numpy as np
 
 from .models.gbdt import GBDT
+from .obs.metrics import observe_predict
 from .utils.log import Log
 
 
@@ -96,12 +99,13 @@ class Predictor:
                 "none", early_stop_freq, early_stop_margin)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
-        import time
-        from .obs.metrics import observe_predict
         t0 = time.perf_counter()
         out = self._predict_impl(features)
-        observe_predict(np.asarray(out).shape[0] if np.ndim(out) else 1,
-                        time.perf_counter() - t0)
+        # row accounting from the INPUT (a 1-D request is one row):
+        # converted k=1 outputs are 1-D and multiclass outputs are
+        # (n, k) — both count n rows, never ndim quirks
+        rows = 1 if np.ndim(features) <= 1 else np.shape(features)[0]
+        observe_predict(rows, time.perf_counter() - t0)
         return out
 
     def _predict_impl(self, features: np.ndarray) -> np.ndarray:

@@ -1,0 +1,21 @@
+"""Production serving tier: AOT-compiled predictors + async microbatching.
+
+``Booster.serve()`` is the entry point; see docs/Serving.md for the
+architecture and capacity-planning guidance.
+
+* ``executable.py`` — ``PredictExecutableCache``: predict programs
+  AOT-lowered per (batch-bucket, num_trees, k, raw/converted) with
+  donated input buffers and the model replicated per device via
+  NamedSharding, so steady-state scoring never touches the jit dispatch
+  cache (zero recompiles after warmup, gated by ``obs recompiles
+  --check``).
+* ``scheduler.py`` — ``MicrobatchScheduler`` / ``ServingPredictor``: an
+  async coalescer that batches concurrent requests into padded
+  power-of-two buckets under a max-latency deadline, with early-stop and
+  ``pred_contrib`` served through the same queue.
+"""
+from .executable import PredictExecutableCache, next_pow2
+from .scheduler import MicrobatchScheduler, ServingPredictor
+
+__all__ = ["MicrobatchScheduler", "PredictExecutableCache",
+           "ServingPredictor", "next_pow2"]

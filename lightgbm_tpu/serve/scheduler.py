@@ -1,0 +1,339 @@
+"""Async microbatch scheduler: coalesce concurrent predict requests.
+
+A serving process sees many small concurrent requests; the device wants
+few large batches.  ``MicrobatchScheduler`` sits between them: callers
+``submit()`` feature blocks and get a ``concurrent.futures.Future``; a
+single worker thread coalesces the queue head into one batch until it
+reaches ``max_batch`` rows or the OLDEST queued request has waited
+``max_delay_ms`` — the deadline that bounds p99 latency when traffic is
+too thin to fill a bucket.  The batch then runs ONCE through the bucket
+executable (serve/executable.py) and the result is split back per
+request.
+
+Correctness leans on row independence: every row of the batched program
+computes exactly what it would compute alone (element-wise Kahan lanes,
+no cross-row reductions), so a caller cannot tell — bit for bit —
+whether its rows shared a bucket with strangers.  tests/test_serve.py
+pins concurrent-vs-solo equality.
+
+Requests with different semantics (raw vs converted, early-stop,
+pred_contrib) carry a route key; only same-route neighbors coalesce.
+Early-stop and contrib requests batch through the host predictor paths
+(row-independent f64, identical to ``Booster.predict``), so the one
+queue fronts every prediction flavor.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..obs.events import NULL_OBSERVER
+from ..obs.metrics import (REGISTRY, observe_serve_batch,
+                           observe_serve_request)
+from ..utils.log import Log
+
+
+class _Request:
+    __slots__ = ("features", "n", "future", "t")
+
+    def __init__(self, features, n, future, t):
+        self.features = features
+        self.n = n
+        self.future = future
+        self.t = t
+
+
+class MicrobatchScheduler:
+    """The generic coalescing core: a FIFO of (route, features) requests
+    drained by one worker thread into per-route batches.
+
+    ``runner(route, features)`` scores one concatenated (n, F) block and
+    returns an array whose leading axis is rows; the scheduler slices it
+    back per request.  Head-of-line batching preserves submission order:
+    only the leading run of same-route requests coalesces, so a stream
+    of mixed routes drains fairly.
+    """
+
+    def __init__(self, runner, max_batch: int = 8192,
+                 max_delay_ms: float = 2.0, observer=None,
+                 batch_event_every: int = 0, name: str = "serve",
+                 bucket_for=None):
+        self._runner = runner
+        # route-aware bucket sizing for the pad/bucket accounting on
+        # serve_batch events (rows == bucket when absent — host routes)
+        self._bucket_for = bucket_for or (lambda route, rows: rows)
+        self.max_batch = max(1, int(max_batch))
+        self.max_delay_s = max(0.0, float(max_delay_ms)) / 1e3
+        self.observer = observer if observer is not None else NULL_OBSERVER
+        self.batch_event_every = max(0, int(batch_event_every))
+        self.name = name
+        self._queue = collections.deque()   # (route, _Request)
+        self._cv = threading.Condition()
+        self._closing = False
+        self._batches = 0
+        self._rows = 0
+        self._pad_rows = 0
+        self._max_depth = 0
+        self._inflight = REGISTRY.gauge(
+            "lgbm_serve_queue_depth",
+            "requests waiting in the microbatch queue")
+        self._worker = threading.Thread(
+            target=self._loop, name="%s-microbatch" % name, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, route, features, n_rows: int) -> Future:
+        """Enqueue one request; resolves to the route runner's output
+        rows for this request (exceptions propagate to the future)."""
+        fut = Future()
+        req = _Request(features, int(n_rows), fut, time.perf_counter())
+        with self._cv:
+            if self._closing:
+                raise RuntimeError("%s: scheduler is closed" % self.name)
+            self._queue.append((route, req))
+            depth = len(self._queue)
+            self._max_depth = max(self._max_depth, depth)
+            self._inflight.set(depth)
+            self._cv.notify()
+        return fut
+
+    # ------------------------------------------------------------- worker
+    def _head_rows(self, route) -> int:
+        rows = 0
+        for r, req in self._queue:
+            if r != route:
+                break
+            rows += req.n
+        return rows
+
+    def _pop_batch(self, route):
+        """The leading same-route run, capped at max_batch rows (a
+        single oversized request still pops alone — the runner chunks)."""
+        batch = []
+        rows = 0
+        while self._queue and self._queue[0][0] == route:
+            req = self._queue[0][1]
+            if batch and rows + req.n > self.max_batch:
+                break
+            self._queue.popleft()
+            batch.append(req)
+            rows += req.n
+        self._inflight.set(len(self._queue))
+        return batch
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._closing:
+                    self._cv.wait()
+                if not self._queue:
+                    return                        # closing, drained
+                route, head = self._queue[0]
+                deadline = head.t + self.max_delay_s
+                while not self._closing:
+                    if self._head_rows(route) >= self.max_batch:
+                        break
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = self._pop_batch(route)
+            self._run_batch(route, batch)
+
+    def _run_batch(self, route, batch):
+        t0 = time.perf_counter()
+        queue_s = t0 - batch[0].t
+        try:
+            if len(batch) == 1:
+                feats = batch[0].features
+            else:
+                feats = np.concatenate([r.features for r in batch])
+            out = self._runner(route, feats)
+        except Exception as e:                    # surface per caller
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        lo = 0
+        for r in batch:
+            r.future.set_result(out[lo:lo + r.n])
+            lo += r.n
+            observe_serve_request(now - r.t)
+        rows = lo
+        self._batches += 1
+        self._rows += rows
+        exec_s = now - t0
+        bucket = self._bucket_for(route, rows)
+        pad = max(bucket - rows, 0)
+        self._pad_rows += pad
+        observe_serve_batch(route, rows, pad, bucket, queue_s, exec_s)
+        obs = self.observer
+        if (obs.enabled and self.batch_event_every
+                and self._batches % self.batch_event_every == 0):
+            obs.event("serve_batch", route=str(route), rows=rows,
+                      bucket=bucket, pad=pad, requests=len(batch),
+                      queue_s=round(queue_s, 6), exec_s=round(exec_s, 6))
+
+    # -------------------------------------------------------------- admin
+    def stats(self) -> dict:
+        return {"batches": self._batches, "rows": self._rows,
+                "pad_rows": self._pad_rows,
+                "max_queue_depth": self._max_depth}
+
+    def close(self):
+        """Flush the queue and stop the worker; idempotent."""
+        with self._cv:
+            if self._closing and not self._worker.is_alive():
+                return
+            self._closing = True
+            self._cv.notify_all()
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+class ServingPredictor:
+    """The production predict front end: one object per model snapshot,
+    shared by any number of submitting threads.
+
+    * plain / raw predictions route through the AOT executable cache
+      (device path, zero steady-state recompiles);
+    * ``pred_early_stop`` / ``pred_contrib`` route through the host
+      predictor paths — batched through the same queue, bit-identical
+      to ``Booster.predict``;
+    * a model whose features the device path cannot encode (mixed
+      categorical/numerical use) falls back to the host predictor for
+      every route, transparently.
+
+    Output shapes match ``Booster.predict``: 1-D for single-output
+    models, (n, k) for multiclass, (n, num_features + 1) for contrib.
+    """
+
+    def __init__(self, gbdt, num_iteration: int = -1, num_features=None,
+                 max_batch: int = 8192, max_delay_ms: float = 2.0,
+                 bucket_min: int = 64, donate: str = "auto",
+                 devices=None, observer=None, batch_event_every: int = 0):
+        from .executable import PredictExecutableCache
+        self.gbdt = gbdt
+        self.num_iteration = int(num_iteration)
+        self.observer = observer if observer is not None else NULL_OBSERVER
+        self.cache = None
+        try:
+            self.cache = PredictExecutableCache(
+                gbdt, num_iteration=num_iteration,
+                num_features=num_features, devices=devices, donate=donate,
+                bucket_min=bucket_min, max_batch=max_batch,
+                observer=self.observer)
+        except ValueError as e:
+            Log.warning("serve: device executables unavailable (%s); "
+                        "serving from the host predictor", e)
+        self._host_predictors = {}
+        self._host_lock = threading.Lock()
+        self.scheduler = MicrobatchScheduler(
+            self._run_route, max_batch=max_batch,
+            max_delay_ms=max_delay_ms, observer=self.observer,
+            batch_event_every=batch_event_every,
+            bucket_for=self._bucket_of)
+
+    # -------------------------------------------------------------- routes
+    def _bucket_of(self, route, rows):
+        if self.cache is not None and route[0] == "dev" \
+                and rows <= self.cache.max_batch:
+            return self.cache.bucket_for(rows)
+        return rows
+
+    def _host_predictor(self, key):
+        """Memoized host Predictor per (raw, early_stop, freq, margin)."""
+        with self._host_lock:
+            p = self._host_predictors.get(key)
+            if p is None:
+                from ..predictor import Predictor
+                raw, early, freq, margin = key
+                p = Predictor(self.gbdt, num_iteration=self.num_iteration,
+                              raw_score=raw, early_stop=early,
+                              early_stop_freq=freq,
+                              early_stop_margin=margin)
+                self._host_predictors[key] = p
+            return p
+
+    def _run_route(self, route, feats):
+        kind = route[0]
+        if kind == "dev":
+            convert = route[1]
+            out = self.cache.predict_batch(feats, convert=convert)
+            return out[:, 0] if self.cache.k == 1 else out
+        if kind == "contrib":
+            return self.gbdt.pred_contrib(
+                feats, num_iteration=self.num_iteration)
+        # host routes: ("host", raw) and ("es", raw, freq, margin)
+        if kind == "es":
+            _, raw, freq, margin = route
+            return self._host_predictor((raw, True, freq, margin)
+                                        ).predict(feats)
+        return self._host_predictor((route[1], False, 10, 10.0)
+                                    ).predict(feats)
+
+    def _route_for(self, raw_score, pred_contrib, pred_early_stop,
+                   freq, margin):
+        if pred_contrib:
+            return ("contrib",)
+        if pred_early_stop:
+            return ("es", bool(raw_score), int(freq), float(margin))
+        if self.cache is not None:
+            return ("dev", not raw_score)
+        return ("host", bool(raw_score))
+
+    # -------------------------------------------------------------- public
+    def submit(self, features, raw_score: bool = False,
+               pred_contrib: bool = False, pred_early_stop: bool = False,
+               pred_early_stop_freq: int = 10,
+               pred_early_stop_margin: float = 10.0) -> Future:
+        """Enqueue one request; the future resolves to the same array
+        ``Booster.predict`` would return for these rows."""
+        X = np.asarray(features, np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        X = np.ascontiguousarray(X)
+        route = self._route_for(raw_score, pred_contrib, pred_early_stop,
+                                pred_early_stop_freq,
+                                pred_early_stop_margin)
+        return self.scheduler.submit(route, X, X.shape[0])
+
+    def predict(self, features, **kw) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(features, **kw).result()
+
+    def warmup(self, sizes=(), raw_score: bool = False):
+        """Pre-compile the bucket executables covering ``sizes`` row
+        counts, then mark the cache warm so any later compile counts as
+        a steady-state violation.  Returns the compiled bucket list."""
+        buckets = []
+        if self.cache is not None and sizes:
+            buckets = self.cache.warmup(sizes, convert=not raw_score)
+            self.cache.mark_warm()
+        return buckets
+
+    def stats(self) -> dict:
+        out = dict(self.scheduler.stats())
+        if self.cache is not None:
+            out["executables"] = self.cache.stats()
+        return out
+
+    def close(self):
+        self.scheduler.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -116,6 +116,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_importance_k": "obs_importance_topk",
     "obs_profile_data": "obs_data_profile",
     "obs_dataset_profile": "obs_data_profile",
+    "serve_microbatch_max": "serve_max_batch",
+    "serve_deadline_ms": "serve_max_delay_ms",
+    "serve_min_bucket": "serve_bucket_min",
+    "serve_donate_buffers": "serve_donate",
+    "serve_batch_events": "serve_batch_event_every",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -168,6 +173,9 @@ PARAMETER_SET = {
     "obs_watchdog_secs", "obs_fsync", "obs_flight_events",
     "obs_split_audit", "obs_importance_every", "obs_importance_topk",
     "obs_data_profile",
+    # serving tier (lightgbm_tpu/serve/)
+    "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
+    "serve_donate", "serve_batch_event_every",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -555,6 +563,30 @@ class Config:
         # obs_health channel (warn logs, fatal aborts naming the
         # feature).  Does NOT enable the observer by itself.
         "obs_data_profile": ("bool", True),
+        # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
+        # Booster.serve() microbatcher over AOT-compiled predict
+        # executables.  Largest coalesced microbatch (and the largest
+        # compiled batch bucket); bigger requests run in max_batch
+        # chunks through the same executables.
+        "serve_max_batch": ("int", 8192),
+        # coalescing deadline: a microbatch flushes when it reaches
+        # serve_max_batch rows OR the oldest queued request has waited
+        # this many milliseconds — the knob trading p99 latency for
+        # bucket fill / throughput
+        "serve_max_delay_ms": ("float", 2.0),
+        # smallest batch bucket: request rows round UP to the nearest
+        # power of two between serve_bucket_min and serve_max_batch, so
+        # the executable cache holds at most
+        # log2(max_batch / bucket_min) + 1 programs per route
+        "serve_bucket_min": ("int", 64),
+        # donate the encoded input buffers to the predict executable
+        # ('auto' | 'true' | 'false'); auto donates on accelerator
+        # backends and keeps CPU un-donated (the CPU runtime lacks
+        # donation and would warn per call)
+        "serve_donate": ("str", "auto"),
+        # emit a `serve_batch` timeline event every Nth microbatch when
+        # an observer is attached (0 = off; metrics always record)
+        "serve_batch_event_every": ("int", 0),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

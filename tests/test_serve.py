@@ -1,0 +1,316 @@
+"""Serving tier (lightgbm_tpu/serve): AOT bucket executables + the
+async microbatch scheduler.
+
+The load-bearing invariant: a row scores bit-identically whatever
+bucket it lands in and whoever it shares the bucket with — element-wise
+Kahan lanes, no cross-row ops — so concurrent submissions through the
+coalescing queue must equal solo submissions exactly, and the steady
+state must never compile."""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (MicrobatchScheduler, PredictExecutableCache,
+                                ServingPredictor, next_pow2)
+
+
+def _train(params=None, rounds=12, rows=600, features=8, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, features))
+    w = rng.normal(size=features)
+    y = (X @ w + 0.2 * rng.normal(size=rows) > 0).astype(np.float64)
+    p = dict({"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}, **(params or {}))
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                     num_boost_round=rounds), X
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 4, 5, 63, 64, 65)] == \
+        [1, 2, 4, 4, 8, 64, 64, 128]
+
+
+# ---------------------------------------------------------------- executable
+def test_executable_cache_matches_predict_and_buckets():
+    bst, X = _train()
+    cache = PredictExecutableCache(bst._gbdt, bucket_min=16, max_batch=256)
+    assert cache.bucket_for(1) == 16
+    assert cache.bucket_for(17) == 32
+    assert cache.bucket_for(5000) == 256        # capped at max_batch
+    want = bst.predict(X)
+    got = cache.predict_batch(X)[:, 0]          # chunks over max_batch
+    assert np.allclose(got, want, rtol=2e-6, atol=1e-7)
+    raw = cache.predict_batch(X[:40], convert=False)[:, 0]
+    assert np.allclose(raw, bst.predict(X[:40], raw_score=True),
+                       rtol=2e-6, atol=1e-7)
+
+
+def test_executable_bucket_reuse_no_steady_state_compiles():
+    bst, X = _train()
+    cache = PredictExecutableCache(bst._gbdt, bucket_min=16, max_batch=128)
+    cache.warmup(sizes=[1, 20, 50, 128])
+    warm = cache.compiles
+    cache.mark_warm()
+    full = cache.predict_batch(X[:128])
+    for n in (1, 2, 9, 16, 17, 40, 100, 128):   # all land on warm rungs
+        got = cache.predict_batch(X[:n])
+        # bit-identical to the full-bucket run: padding and bucket
+        # choice must not leak into any row's arithmetic
+        assert np.array_equal(got, full[:n]), n
+    assert cache.compiles == warm
+    assert cache.steady_state_compiles == 0
+
+
+def test_executable_normalize_widths():
+    bst, X = _train(features=8)
+    cache = PredictExecutableCache(bst._gbdt, bucket_min=16)
+    want = cache.predict_batch(X[:10])
+    # wider input: extra columns sliced off
+    wide = np.concatenate([X[:10], np.ones((10, 3))], axis=1)
+    assert np.array_equal(cache.predict_batch(wide), want)
+    # 1-D input promotes to one row
+    one = cache.predict_batch(X[0])
+    assert one.shape[0] == 1 and np.array_equal(one[0], want[0])
+    # too narrow to cover the model's features: refused
+    with pytest.raises(ValueError):
+        cache.predict_batch(X[:4, :1])
+
+
+# ----------------------------------------------------------------- scheduler
+def test_scheduler_coalesces_and_splits():
+    seen = []
+
+    def runner(route, feats):
+        seen.append(feats.shape[0])
+        return feats[:, :1] * 2.0
+
+    with MicrobatchScheduler(runner, max_batch=64,
+                             max_delay_ms=40.0) as sched:
+        blocks = [np.full((n, 3), float(n)) for n in (2, 3, 4)]
+        futs = [sched.submit("r", b, len(b)) for b in blocks]
+        outs = [f.result(timeout=10) for f in futs]
+    for b, o in zip(blocks, outs):
+        assert np.array_equal(o, b[:, :1] * 2.0)
+    # the three requests landed within one deadline -> fewer batches
+    # than requests, and every batch respected the row cap
+    assert sum(seen) == 9 and max(seen) <= 64
+
+
+def test_scheduler_deadline_flushes_lone_request():
+    def runner(route, feats):
+        return np.zeros((feats.shape[0], 1))
+
+    with MicrobatchScheduler(runner, max_batch=4096,
+                             max_delay_ms=30.0) as sched:
+        t0 = time.perf_counter()
+        sched.submit("r", np.zeros((3, 2)), 3).result(timeout=10)
+        dt = time.perf_counter() - t0
+    # a lone sub-bucket request must not wait for a full batch: the
+    # deadline flushes it — well under a second even on a loaded CI box
+    assert dt < 5.0
+    assert sched.stats()["batches"] == 1
+
+
+def test_scheduler_routes_do_not_mix():
+    batches = []
+
+    def runner(route, feats):
+        batches.append((route, feats.shape[0]))
+        return np.zeros((feats.shape[0], 1))
+
+    with MicrobatchScheduler(runner, max_batch=64,
+                             max_delay_ms=30.0) as sched:
+        futs = [sched.submit(route, np.zeros((2, 2)), 2)
+                for route in ("a", "a", "b", "a")]
+        for f in futs:
+            f.result(timeout=10)
+    # same-route neighbors may coalesce; "a" and "b" never share a batch
+    assert sum(n for _, n in batches) == 8
+    assert all(route in ("a", "b") for route, _ in batches)
+
+
+def test_scheduler_runner_error_propagates_and_close_rejects():
+    def runner(route, feats):
+        raise RuntimeError("boom")
+
+    sched = MicrobatchScheduler(runner, max_delay_ms=1.0)
+    fut = sched.submit("r", np.zeros((1, 2)), 1)
+    with pytest.raises(RuntimeError, match="boom"):
+        fut.result(timeout=10)
+    sched.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.submit("r", np.zeros((1, 2)), 1)
+
+
+# ----------------------------------------------------------- serving predictor
+def test_concurrent_submissions_bit_identical_to_solo():
+    bst, X = _train()
+    with ServingPredictor(bst._gbdt, max_delay_ms=10.0,
+                          bucket_min=16) as sp:
+        solo = [sp.predict(X[lo:lo + n])
+                for lo, n in ((0, 7), (50, 31), (200, 64), (300, 3))]
+        barrier = threading.Barrier(4)
+        futs = [None] * 4
+
+        def fire(i, lo, n):
+            barrier.wait()
+            futs[i] = sp.submit(X[lo:lo + n])
+
+        ts = [threading.Thread(target=fire, args=(i, lo, n))
+              for i, (lo, n) in enumerate(((0, 7), (50, 31), (200, 64),
+                                           (300, 3)))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        together = [f.result(timeout=30) for f in futs]
+    for s, t in zip(solo, together):
+        assert np.array_equal(s, t)     # bit-identical, not allclose
+
+
+def test_serve_matches_booster_predict_shapes_and_values():
+    bst, X = _train()
+    with ServingPredictor(bst._gbdt, max_delay_ms=1.0) as sp:
+        conv = sp.predict(X[:50])
+        raw = sp.predict(X[:50], raw_score=True)
+    assert conv.shape == (50,)                  # 1-D like Booster.predict
+    assert np.allclose(conv, bst.predict(X[:50]), rtol=2e-6, atol=1e-7)
+    assert np.allclose(raw, bst.predict(X[:50], raw_score=True),
+                       rtol=2e-6, atol=1e-7)
+
+
+def test_serve_multiclass_softmax_fused():
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(400, 6))
+    y = rng.integers(0, 3, size=400).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+         "num_leaves": 7, "min_data_in_leaf": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=9)
+    with ServingPredictor(bst._gbdt, max_delay_ms=1.0) as sp:
+        got = sp.predict(X[:30])
+    want = bst.predict(X[:30])
+    assert got.shape == want.shape == (30, 3)
+    assert np.allclose(got, want, rtol=2e-6, atol=1e-7)
+
+
+def test_serve_early_stop_and_contrib_round_trip():
+    bst, X = _train(rounds=30)
+    with ServingPredictor(bst._gbdt, max_delay_ms=5.0) as sp:
+        es = sp.predict(X[:80], pred_early_stop=True,
+                        pred_early_stop_freq=2, pred_early_stop_margin=1.0)
+        contrib = sp.predict(X[:80], pred_contrib=True)
+    # both host routes are bit-equal to the Booster entry points
+    want_es = bst.predict(X[:80], pred_early_stop=True,
+                          pred_early_stop_freq=2,
+                          pred_early_stop_margin=1.0)
+    assert np.array_equal(es, want_es)
+    assert not np.array_equal(es, bst.predict(X[:80]))   # it engaged
+    assert np.array_equal(contrib, bst.predict(X[:80], pred_contrib=True))
+    assert contrib.shape == (80, X.shape[1] + 1)
+
+
+def test_serve_zero_steady_state_compiles_under_mixed_load():
+    bst, X = _train()
+    with ServingPredictor(bst._gbdt, max_delay_ms=2.0, bucket_min=16,
+                          max_batch=256) as sp:
+        sp.cache.warmup([16, 32, 64, 128, 256])
+        sp.cache.mark_warm()
+        futs = [sp.submit(X[lo:lo + n]) for lo, n in
+                ((0, 1), (9, 30), (80, 120), (300, 256), (10, 5))]
+        for f in futs:
+            f.result(timeout=30)
+        assert sp.cache.steady_state_compiles == 0
+        assert sp.stats()["batches"] >= 1
+
+
+def test_booster_serve_reads_config_params():
+    bst, X = _train(params={"serve_max_batch": 128,
+                            "serve_max_delay_ms": 7.5,
+                            "serve_bucket_min": 32})
+    with bst.serve() as sp:
+        assert sp.scheduler.max_batch == 128
+        assert sp.scheduler.max_delay_s == pytest.approx(0.0075)
+        assert sp.cache.bucket_min == 32
+        assert np.allclose(sp.predict(X[:20]), bst.predict(X[:20]),
+                           rtol=2e-6, atol=1e-7)
+    with bst.serve(max_batch=64) as sp:      # kwargs override config
+        assert sp.scheduler.max_batch == 64
+
+
+def test_serve_host_fallback_on_unencodable_model(monkeypatch):
+    bst, X = _train()
+    from lightgbm_tpu.serve import executable as exe_mod
+
+    def boom(*a, **k):
+        raise ValueError("mixed categorical/numerical use (test)")
+
+    monkeypatch.setattr(exe_mod.dev_predict, "build_ranked_predictor",
+                        boom)
+    with ServingPredictor(bst._gbdt, max_delay_ms=1.0) as sp:
+        assert sp.cache is None
+        got = sp.predict(X[:25])            # host route, still serves
+    assert np.array_equal(got, bst.predict(X[:25]))
+
+
+# ------------------------------------------------------- plain-predict bucket
+def test_gbdt_bulk_predict_buckets_reuse_jit_cache():
+    from lightgbm_tpu.ops.predict import ranked_predict_device
+    bst, X = _train(rows=900)
+    bst._gbdt.config.tpu_predict = "true"
+    full = bst.predict(X)
+    # warm one predict per rung (256, 512, 1024); repeats at novel sizes
+    # must hit the same executables — sliced results stay exact
+    for n in (200, 400, 800):
+        assert np.array_equal(bst.predict(X[:n]), full[:n])
+    warm = ranked_predict_device._cache_size()
+    for n in (1, 37, 250, 511, 700, 899):
+        assert np.array_equal(bst.predict(X[:n]), full[:n])
+    assert ranked_predict_device._cache_size() == warm
+
+
+# ------------------------------------------------------------ observability
+def test_observe_predict_counts_input_rows():
+    from lightgbm_tpu.obs.metrics import REGISTRY
+    bst, X = _train()
+
+    def rows_total():
+        snap = REGISTRY.snapshot().get("lgbm_predict_rows_total")
+        return snap["value"] if snap else 0
+
+    base = rows_total()
+    bst.predict(X[:17])                     # converted output is 1-D
+    assert rows_total() == base + 17
+    bst.predict(X[0])                       # one 1-D request = one row
+    assert rows_total() == base + 18
+    from lightgbm_tpu.predictor import Predictor
+    Predictor(bst._gbdt).predict(X[:5])
+    assert rows_total() == base + 23
+
+
+def test_serve_timeline_events(tmp_path):
+    from lightgbm_tpu.obs import RunObserver, read_events
+    bst, X = _train()
+    path = str(tmp_path / "serve.jsonl")
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    with ServingPredictor(bst._gbdt, max_delay_ms=1.0, observer=obs,
+                          batch_event_every=1) as sp:
+        sp.predict(X[:40])
+        sp.predict(X[:10])
+    obs.event("serve_bench", qps=123.0, p50_s=0.001, p99_s=0.002)
+    obs.close()
+    evs = read_events(path)                 # schema-validates everything
+    kinds = [e["ev"] for e in evs]
+    assert kinds.count("serve_batch") == 2
+    assert "serve_bench" in kinds and "compile_attr" in kinds
+    batches = [e for e in evs if e["ev"] == "serve_batch"]
+    for e in batches:
+        assert e["bucket"] >= e["rows"] and e["pad"] >= 0
+    attr = [e for e in evs if e["ev"] == "compile_attr"]
+    assert all(e["entry"].startswith("serve_predict_b") for e in attr)
+    assert all(e["sig_compiles"] == 1 for e in attr)

@@ -26,7 +26,8 @@ artifact are reported and skipped; no overlap at all is a usage error.
 Usage:
     python tools/bench_compare.py BASELINE CANDIDATE \
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
-        [--tol-recompile 0] [--tol-eval 0.02] [--json]
+        [--tol-recompile 0] [--tol-eval 0.02] \
+        [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -59,6 +60,11 @@ METRICS = {
     # higher-is-better metric (auc — the bench protocol's); a perf win
     # that costs more than 2% quality is a regression, not a win
     "final_eval_metric": (+1, 0.02),
+    # serving-tier load numbers (bench_serve.py: the `serve_bench`
+    # timeline event / JSON line).  Throughput and tail latency gate
+    # separately — a QPS win that blows up p99 is not a win
+    "serve_qps": (+1, 0.15),
+    "serve_p99_s": (-1, 0.30),
 }
 
 
@@ -110,6 +116,11 @@ def _from_timeline(events):
              and e.get("results")]
     if evals:
         out["final_eval_metric"] = float(evals[-1]["results"][-1]["value"])
+    # serving-tier load results (bench_serve.py timelines)
+    serve = [e for e in events if e.get("ev") == "serve_bench"]
+    if serve:
+        out["serve_qps"] = float(serve[-1]["qps"])
+        out["serve_p99_s"] = float(serve[-1]["p99_s"])
     return out
 
 
@@ -124,6 +135,10 @@ def _from_parsed(parsed):
         out["iters_per_sec"] = float(value)
     if parsed.get("final_eval_metric") is not None:
         out["final_eval_metric"] = float(parsed["final_eval_metric"])
+    if parsed.get("serve_qps") is not None:
+        out["serve_qps"] = float(parsed["serve_qps"])
+    if parsed.get("serve_p99_s") is not None:
+        out["serve_p99_s"] = float(parsed["serve_p99_s"])
     return out
 
 
@@ -214,13 +229,20 @@ def main(argv=None):
     ap.add_argument("--tol-eval", type=float, default=METRICS[
         "final_eval_metric"][1],
         help="final eval-metric relative tolerance (higher-is-better)")
+    ap.add_argument("--tol-serve-qps", type=float, default=METRICS[
+        "serve_qps"][1], help="serving QPS relative tolerance")
+    ap.add_argument("--tol-serve-p99", type=float, default=METRICS[
+        "serve_p99_s"][1],
+        help="serving p99-latency relative tolerance")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
     tols = {"iters_per_sec": args.tol_ips, "compile_s": args.tol_compile,
             "peak_mem_bytes": args.tol_mem,
             "recompile_count": args.tol_recompile,
-            "final_eval_metric": args.tol_eval}
+            "final_eval_metric": args.tol_eval,
+            "serve_qps": args.tol_serve_qps,
+            "serve_p99_s": args.tol_serve_p99}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)
