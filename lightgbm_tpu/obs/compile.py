@@ -41,6 +41,10 @@ from ..utils.log import Log
 # default labels for the top-level positions of a registered entry call
 _POS = "a%d"
 
+# fn_ref sentinel: the entry's callable is not weakrefable, so program
+# identity cannot be tracked without pinning the object in memory
+_UNTRACKABLE = object()
+
 
 def _leaf_descr(leaf):
     """(kind, shape, dtype) of one flattened argument leaf."""
@@ -243,11 +247,16 @@ class CompileTracker:
         # to the replacement, masking the rebuild — and a dead ref IS a
         # rebuild (the old program object is gone)
         prev = st["fn_ref"]
-        rebuilt = prev is not None and prev() is not fn
+        rebuilt = (prev is not None and prev is not _UNTRACKABLE
+                   and prev() is not fn)
         try:
             st["fn_ref"] = weakref.ref(fn)
-        except TypeError:                  # non-weakrefable callable
-            st["fn_ref"] = (lambda obj: (lambda: obj))(fn)
+        except TypeError:
+            # non-weakrefable callable: a strong reference would pin the
+            # old program for the tracker's lifetime and id() can be
+            # reused after GC, so identity is simply untrackable here —
+            # rebuild detection degrades, cache-size counting does not
+            st["fn_ref"] = _UNTRACKABLE
         if cache0 is not None and cache1 is not None:
             compiled = cache1 > cache0
         else:

@@ -296,11 +296,16 @@ def observe_serve_batch(route, rows, pad, bucket, queue_s, exec_s):
     """One coalesced serving microbatch (serve/scheduler.py flush):
     ``rows`` real rows, ``pad`` padding rows added to reach ``bucket``,
     ``queue_s`` the oldest request's coalescing wait, ``exec_s`` the
-    encode+execute+split time."""
+    encode+execute+split time.  The counter is labeled by the route
+    KIND only (``route[0]``): full route tuples embed client-supplied
+    early-stop freq/margin values, which would make label cardinality
+    unbounded — the full tuple stays on the sampled serve_batch
+    timeline events."""
+    kind = route[0] if isinstance(route, tuple) and route else route
     REGISTRY.counter(
         "lgbm_serve_batches_total",
         "coalesced serving microbatches executed",
-        labels={"route": str(route)}).inc()
+        labels={"route": str(kind)}).inc()
     REGISTRY.counter(
         "lgbm_serve_rows_total", "rows scored by the serving tier").inc(
             int(rows))

@@ -142,9 +142,26 @@ class MicrobatchScheduler:
                         break
                     self._cv.wait(remaining)
                 batch = self._pop_batch(route)
-            self._run_batch(route, batch)
+            try:
+                self._run_batch(route, batch)
+            except Exception as e:        # the worker must never die
+                Log.warning("%s: microbatch worker error: %s",
+                            self.name, e)
+                for r in batch:
+                    if not r.future.done():
+                        try:
+                            r.future.set_exception(e)
+                        except Exception:
+                            pass
 
     def _run_batch(self, route, batch):
+        # claim every future first: a request cancelled while queued
+        # drops out here, and a claimed future can no longer be
+        # cancelled, so set_result/set_exception below cannot raise
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
         t0 = time.perf_counter()
         queue_s = t0 - batch[0].t
         try:
@@ -160,7 +177,9 @@ class MicrobatchScheduler:
         now = time.perf_counter()
         lo = 0
         for r in batch:
-            r.future.set_result(out[lo:lo + r.n])
+            # copy, not a view: callers own their result array and must
+            # not be able to corrupt batch neighbors through it
+            r.future.set_result(out[lo:lo + r.n].copy())
             lo += r.n
             observe_serve_request(now - r.t)
         rows = lo
@@ -274,23 +293,26 @@ class ServingPredictor:
         if kind == "contrib":
             return self.gbdt.pred_contrib(
                 feats, num_iteration=self.num_iteration)
-        # host routes: ("host", raw) and ("es", raw, freq, margin)
+        # host routes: ("host", raw, width) and
+        # ("es", raw, freq, margin, width) — width is part of the key
+        # so only same-width requests coalesce (np.concatenate)
         if kind == "es":
-            _, raw, freq, margin = route
+            raw, freq, margin = route[1:4]
             return self._host_predictor((raw, True, freq, margin)
                                         ).predict(feats)
         return self._host_predictor((route[1], False, 10, 10.0)
                                     ).predict(feats)
 
     def _route_for(self, raw_score, pred_contrib, pred_early_stop,
-                   freq, margin):
+                   freq, margin, width):
         if pred_contrib:
-            return ("contrib",)
+            return ("contrib", width)
         if pred_early_stop:
-            return ("es", bool(raw_score), int(freq), float(margin))
+            return ("es", bool(raw_score), int(freq), float(margin),
+                    width)
         if self.cache is not None:
             return ("dev", not raw_score)
-        return ("host", bool(raw_score))
+        return ("host", bool(raw_score), width)
 
     # -------------------------------------------------------------- public
     def submit(self, features, raw_score: bool = False,
@@ -305,7 +327,12 @@ class ServingPredictor:
         X = np.ascontiguousarray(X)
         route = self._route_for(raw_score, pred_contrib, pred_early_stop,
                                 pred_early_stop_freq,
-                                pred_early_stop_margin)
+                                pred_early_stop_margin, X.shape[1])
+        if route[0] == "dev":
+            # one canonical width per dev route, so any two valid
+            # requests can share a batch (too-narrow ones raise HERE,
+            # in the caller, not inside a stranger's microbatch)
+            X = self.cache.normalize(X)
         return self.scheduler.submit(route, X, X.shape[0])
 
     def predict(self, features, **kw) -> np.ndarray:

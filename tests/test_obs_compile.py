@@ -124,6 +124,38 @@ def test_tracker_flags_program_rebuild_as_thrash(tmp_path):
         is True
 
 
+def test_tracker_does_not_pin_non_weakrefable_callable(tmp_path):
+    """A __slots__ callable cannot be weakref'd; the tracker must not
+    fall back to a strong reference that pins the program (and whatever
+    it closes over) for the tracker's lifetime."""
+    import gc
+    import weakref
+
+    class Canary:                # weakrefable marker held only by fn
+        pass
+
+    class Slotted:
+        __slots__ = ("canary",)
+
+        def __call__(self, x):
+            return x
+
+    path = tmp_path / "ev.jsonl"
+    obs = _obs(path, compile_attr=True)
+    x = jnp.ones((4,), jnp.float32)
+    fn = Slotted()
+    fn.canary = Canary()
+    ref = weakref.ref(fn.canary)
+    with obs:
+        _drive(obs, "g", fn, x, names=("x",))
+        _drive(obs, "g", fn, x, names=("x",))     # sentinel path reused
+    attr = [e for e in read_events(path) if e["ev"] == "compile_attr"]
+    assert len(attr) == 1        # repeat signature, no phantom rebuild
+    del fn
+    gc.collect()
+    assert ref() is None         # the tracker held no strong reference
+
+
 def test_learner_rebuild_names_the_row_axis(tmp_path):
     """Shape-unstable input end to end: two learners whose padded row
     counts differ, under one observer; the second compile_attr must

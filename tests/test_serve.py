@@ -8,7 +8,7 @@ coalescing queue must equal solo submissions exactly, and the steady
 state must never compile."""
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 
 import numpy as np
 import pytest
@@ -133,6 +133,50 @@ def test_scheduler_routes_do_not_mix():
     assert all(route in ("a", "b") for route, _ in batches)
 
 
+def test_scheduler_survives_cancelled_future():
+    gate = threading.Event()
+
+    def runner(route, feats):
+        gate.wait(5)
+        return feats[:, :1]
+
+    with MicrobatchScheduler(runner, max_delay_ms=1.0) as sched:
+        first = sched.submit("r", np.zeros((1, 2)), 1)
+        time.sleep(0.05)                  # worker is blocked in runner
+        doomed = sched.submit("r", np.zeros((2, 2)), 2)
+        assert doomed.cancel()            # still queued: cancellable
+        gate.set()
+        first.result(timeout=10)
+        with pytest.raises(CancelledError):
+            doomed.result(timeout=10)
+        # the worker must survive the cancelled future: resolving it
+        # without the set_running_or_notify_cancel() claim raises
+        # InvalidStateError and kills the thread, hanging the tier
+        out = sched.submit("r", np.ones((3, 2)), 3).result(timeout=10)
+    assert out.shape == (3, 1)
+
+
+def test_scheduler_results_are_copies_not_views():
+    gate = threading.Event()
+    sizes = []
+
+    def runner(route, feats):
+        gate.wait(5)
+        sizes.append(feats.shape[0])
+        return feats * 2.0
+
+    with MicrobatchScheduler(runner, max_delay_ms=1.0) as sched:
+        sched.submit("r", np.zeros((1, 2)), 1)
+        time.sleep(0.05)                  # block worker: next two coalesce
+        fa = sched.submit("r", np.ones((2, 2)), 2)
+        fb = sched.submit("r", np.full((3, 2), 3.0), 3)
+        gate.set()
+        a, b = fa.result(timeout=10), fb.result(timeout=10)
+    assert sizes[-1] == 5                 # they shared one batch
+    a[:] = -1.0                           # caller scribbles on its result
+    assert np.array_equal(b, np.full((3, 2), 6.0))
+
+
 def test_scheduler_runner_error_propagates_and_close_rejects():
     def runner(route, feats):
         raise RuntimeError("boom")
@@ -214,6 +258,27 @@ def test_serve_early_stop_and_contrib_round_trip():
     assert contrib.shape == (80, X.shape[1] + 1)
 
 
+def test_serve_mixed_width_requests_coalesce_safely():
+    bst, X = _train(features=8)
+    wide = np.concatenate([X[:6], np.ones((6, 3))], axis=1)
+    with ServingPredictor(bst._gbdt, max_delay_ms=60.0,
+                          bucket_min=16) as sp:
+        # same dev route, different submitted widths: submit-time
+        # normalization gives them one canonical width, so sharing a
+        # microbatch cannot blow up np.concatenate
+        f1, f2 = sp.submit(X[:4]), sp.submit(wide)
+        g1, g2 = f1.result(timeout=30), f2.result(timeout=30)
+        assert np.allclose(g1, bst.predict(X[:4]), rtol=2e-6, atol=1e-7)
+        assert np.allclose(g2, bst.predict(X[:6]), rtol=2e-6, atol=1e-7)
+        # host routes carry the width in the route key instead: the two
+        # early-stop requests never share a batch, and both succeed
+        e1 = sp.submit(X[:4], pred_early_stop=True)
+        e2 = sp.submit(wide, pred_early_stop=True)
+        h1, h2 = e1.result(timeout=30), e2.result(timeout=30)
+    assert np.array_equal(h1, bst.predict(X[:4], pred_early_stop=True))
+    assert np.array_equal(h2, bst.predict(X[:6], pred_early_stop=True))
+
+
 def test_serve_zero_steady_state_compiles_under_mixed_load():
     bst, X = _train()
     with ServingPredictor(bst._gbdt, max_delay_ms=2.0, bucket_min=16,
@@ -290,6 +355,19 @@ def test_observe_predict_counts_input_rows():
     from lightgbm_tpu.predictor import Predictor
     Predictor(bst._gbdt).predict(X[:5])
     assert rows_total() == base + 23
+
+
+def test_serve_batch_counter_labels_route_kind_only():
+    from lightgbm_tpu.obs.metrics import REGISTRY, observe_serve_batch
+    for margin in (12.5, 99.0):           # client-supplied, unbounded
+        observe_serve_batch(("es", False, 10, margin, 8), 4, 0, 4,
+                            0.0, 0.0)
+    series = [k for k in REGISTRY.snapshot()
+              if k.startswith("lgbm_serve_batches_total")]
+    assert 'lgbm_serve_batches_total{route="es"}' in series
+    # never a rendered route tuple: freq/margin values in the label
+    # would make Prometheus cardinality unbounded
+    assert all("(" not in s for s in series)
 
 
 def test_serve_timeline_events(tmp_path):
