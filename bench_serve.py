@@ -16,7 +16,17 @@ Prints ONE JSON line:
 ``--dry`` is the CI smoke (JAX_PLATFORMS=cpu): a tiny model, a short
 mixed-size burst, then hard asserts — schema-valid timeline, zero
 steady-state compiles, every ``compile_attr`` entry compiled exactly
-once, and serve output matching ``Booster.predict``.
+once, serve output matching ``Booster.predict``, zero sheds, and a
+full serving-telemetry trail (serve_request / serve_slo /
+serve_summary) that ``obs serve --check`` accepts.
+
+``--overload`` replaces the closed loop with open-loop bursts against
+a deliberately small queue (tight ``queue_limit`` + per-request
+deadline + a fault-hook execution floor), then asserts the overload
+protection actually worked: nonzero shed rate, p99 of the ADMITTED
+requests still bounded, and a ``slo_burn_rate`` health warning on the
+timeline.  The JSON line gains ``serve_shed_rate`` for
+``tools/bench_compare.py``.
 """
 import argparse
 import json
@@ -70,11 +80,63 @@ def run_load(sp, X, requests, threads, sizes, seed=5):
     return np.concatenate([np.asarray(x) for x in lat]), wall, sum(rows)
 
 
+def run_overload(sp, X, requests, threads, burst, sizes, seed=7):
+    """Open-loop burst load for ``--overload``: each worker fires
+    ``burst`` futures back-to-back (no waiting between submits), then
+    drains them, counting requests the scheduler shed at admission.
+    Returns (admitted_latencies, wall_s, offered, shed, rows_scored)."""
+    from lightgbm_tpu.serve import ServeOverloadError
+    lat = [[] for _ in range(threads)]
+    shed = [0] * threads
+    rows = [0] * threads
+    per = max(requests // threads, 1)
+
+    def worker(i):
+        rng = np.random.default_rng(seed + i)
+        done = 0
+        while done < per:
+            b = min(burst, per - done)
+            done += b
+            pend = []
+            for _ in range(b):
+                n = int(rng.choice(sizes))
+                lo = int(rng.integers(0, max(X.shape[0] - n, 1)))
+                pend.append((time.perf_counter(), n,
+                             sp.submit(X[lo:lo + n])))
+            for t0, n, f in pend:
+                try:
+                    f.result()
+                    lat[i].append(time.perf_counter() - t0)
+                    rows[i] += n
+                except ServeOverloadError:
+                    shed[i] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    return (np.concatenate([np.asarray(x) for x in lat]), wall,
+            per * threads, sum(shed), sum(rows))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="serving-tier load benchmark (p50/p99 latency, QPS)")
     ap.add_argument("--dry", action="store_true",
                     help="CI smoke: tiny shape + hard telemetry asserts")
+    ap.add_argument("--overload", action="store_true",
+                    help="open-loop burst load against a small queue + "
+                         "per-request deadline; asserts shed rate > 0, "
+                         "bounded p99 of admitted, burn-rate alert")
+    ap.add_argument("--queue-limit", type=int, default=None,
+                    help="scheduler queue limit in requests "
+                         "(overload default 48)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline (overload default 50)")
     ap.add_argument("--rows", type=int, default=None,
                     help="training rows (default 4000 dry / 200000 full)")
     ap.add_argument("--features", type=int, default=28)
@@ -96,7 +158,8 @@ def main(argv=None):
     rows = args.rows or (4000 if args.dry else 200_000)
     leaves = args.leaves or (15 if args.dry else 255)
     rounds = args.rounds or (10 if args.dry else 100)
-    requests = args.requests or (400 if args.dry else 5000)
+    requests = args.requests or (1600 if args.overload
+                                 else 400 if args.dry else 5000)
     obs_path = args.obs_path or ("/tmp/bench_serve_obs_%d.jsonl"
                                  % os.getpid())
     try:
@@ -124,9 +187,30 @@ def main(argv=None):
     # flush, padding, and every bucket rung all see traffic
     sizes = [1, 3, 16, 50, 120, 400] if args.dry else \
             [1, 8, 32, 100, 256, 512, 1024]
-    with bst.serve(max_delay_ms=args.max_delay_ms,
-                   max_batch=args.max_batch, observer=obs,
-                   batch_event_every=8) as sp:
+    serve_kw = {"max_delay_ms": args.max_delay_ms,
+                "max_batch": args.max_batch, "observer": obs,
+                "batch_event_every": 8}
+    deadline_ms = 0.0
+    if args.overload:
+        # small queue, tight deadline, a fault-hook execution floor so
+        # even a fast CPU model saturates, and an SLO target every
+        # request will blow through — the burn-rate alert MUST fire
+        deadline_ms = args.deadline_ms or 50.0
+        sizes = [1, 3, 8]
+        serve_kw.update(
+            max_batch=32, max_delay_ms=1.0,
+            queue_limit=args.queue_limit or 48,
+            request_deadline_ms=deadline_ms,
+            request_event_every=8, batch_event_every=4,
+            slo_p99_ms=5.0, slo_window_s=3.0, slo_every_s=0.25,
+            slo_mode="warn",
+            fault_hook=lambda route, batch: time.sleep(0.004))
+    elif args.dry:
+        # generous targets: the point is the telemetry trail
+        # (serve_request / serve_slo / serve_summary), not breaching
+        serve_kw.update(request_event_every=4, slo_p99_ms=60_000.0,
+                        slo_window_s=5.0, slo_every_s=0.5)
+    with bst.serve(**serve_kw) as sp:
         # warm the FULL rung ladder (coalesced batches can land on any
         # bucket up to max_batch), then mark warm: any later compile is
         # a steady-state violation
@@ -139,25 +223,37 @@ def main(argv=None):
             rungs.append(sp.cache.max_batch)
             buckets = sp.cache.warmup(rungs)
             sp.cache.mark_warm()
-        lat, wall, nrows = run_load(sp, X, requests, args.threads, sizes)
+        if args.overload:
+            lat, wall, offered, shed, nrows = run_overload(
+                sp, X, requests, args.threads, burst=24, sizes=sizes)
+        else:
+            lat, wall, nrows = run_load(sp, X, requests, args.threads,
+                                        sizes)
+            offered, shed = len(lat), 0
         stats = sp.stats()
-    qps = len(lat) / wall
-    p50 = float(np.percentile(lat, 50))
-    p99 = float(np.percentile(lat, 99))
+    qps = len(lat) / wall if wall else 0.0
+    p50 = float(np.percentile(lat, 50)) if len(lat) else 0.0
+    p99 = float(np.percentile(lat, 99)) if len(lat) else 0.0
+    shed_rate = shed / float(offered) if offered else 0.0
     ssc = (stats.get("executables") or {}).get("steady_state_compiles")
 
     obs.event("serve_bench", qps=round(qps, 3),
               p50_s=round(p50, 6), p99_s=round(p99, 6),
               requests=len(lat), rows=int(nrows),
-              rows_per_s=round(nrows / wall, 1),
+              rows_per_s=round(nrows / wall, 1) if wall else 0.0,
               threads=args.threads, wall_s=round(wall, 3),
               batches=stats["batches"], pad_rows=stats["pad_rows"],
-              buckets=buckets,
+              buckets=buckets, offered=int(offered), shed=int(shed),
+              shed_rate=round(shed_rate, 4),
+              deadline_ms=deadline_ms,
               steady_state_compiles=ssc)
     obs.close()
 
-    if args.dry:
-        _dry_asserts(bst, X, obs_path, ssc)
+    if args.overload:
+        _overload_asserts(obs_path, offered, shed, p99, deadline_ms,
+                          stats)
+    elif args.dry:
+        _dry_asserts(bst, X, obs_path, ssc, stats)
 
     print(json.dumps({
         "metric": "serve_qps_mixed%dthreads" % args.threads,
@@ -165,20 +261,30 @@ def main(argv=None):
         "serve_qps": round(qps, 3),
         "serve_p50_s": round(p50, 6), "serve_p99_s": round(p99, 6),
         "requests": len(lat), "rows": int(nrows),
+        "offered": int(offered), "serve_shed": int(shed),
+        "serve_shed_rate": round(shed_rate, 4),
         "steady_state_compiles": ssc,
         "path": obs_path,
     }))
 
 
-def _dry_asserts(bst, X, obs_path, steady_state_compiles):
-    """The CI gates: parseable timeline, the serve event trail present,
-    zero steady-state compiles, and correct predictions."""
+def _dry_asserts(bst, X, obs_path, steady_state_compiles, stats):
+    """The CI gates: parseable timeline, the serve event trail present
+    (batch traces, sampled request traces, SLO snapshots, the lifetime
+    summary), zero steady-state compiles, zero sheds, and correct
+    predictions."""
     from lightgbm_tpu.obs import read_events
     evs = read_events(obs_path)          # validates every record
     kinds = {e["ev"] for e in evs}
     for need in ("run_header", "compile", "compile_attr", "serve_batch",
+                 "serve_request", "serve_slo", "serve_summary",
                  "serve_bench", "run_end"):
         assert need in kinds, "serve timeline missing %r events" % need
+    assert stats.get("shed_total", 0) == 0, \
+        "non-overload dry run shed requests: %r" % stats.get("shed")
+    reqs = [e for e in evs if e["ev"] == "serve_request"]
+    assert all("queue_s" in e.get("spans", {}) for e in reqs), \
+        "serve_request trace missing queue_s span"
     serve_attr = [e for e in evs if e["ev"] == "compile_attr"
                   and str(e.get("entry", "")).startswith("serve_predict")]
     assert serve_attr, "no serve compile_attr entries recorded"
@@ -198,6 +304,41 @@ def _dry_asserts(bst, X, obs_path, steady_state_compiles):
     print(json.dumps({"status": "serve_dry_ok", "events": len(evs),
                       "serve_compiles": len(serve_attr)}),
           file=sys.stderr)
+
+
+def _overload_asserts(obs_path, offered, shed, p99_admitted,
+                      deadline_ms, stats):
+    """The overload gates: the protection sheds (rate > 0, matching the
+    scheduler's own count), the ADMITTED requests stay bounded (the
+    admission projection is an EWMA estimate, so allow 3x deadline for
+    CPU scheduling jitter), and the burn-rate alert reached the
+    timeline as a ``slo_burn_rate`` health warning."""
+    from lightgbm_tpu.obs import read_events
+    evs = read_events(obs_path)
+    kinds = {e["ev"] for e in evs}
+    for need in ("serve_request", "serve_slo", "serve_summary",
+                 "serve_bench"):
+        assert need in kinds, "overload timeline missing %r" % need
+    assert shed > 0, ("overload run shed nothing (offered %d) — "
+                      "queue_limit/deadline not engaging" % offered)
+    assert stats.get("shed_total") == shed, \
+        "scheduler shed count %r != caller-observed %d" % (
+            stats.get("shed_total"), shed)
+    bound_s = 3.0 * deadline_ms / 1e3
+    assert p99_admitted <= bound_s, \
+        "p99 of ADMITTED requests %.1fms exceeds %.0fms (3x deadline)" \
+        % (p99_admitted * 1e3, bound_s * 1e3)
+    alerts = [e for e in evs if e["ev"] == "health"
+              and e.get("check") == "slo_burn_rate"
+              and e.get("status") != "ok"]
+    assert alerts, "no slo_burn_rate health warning under overload"
+    summ = [e for e in evs if e["ev"] == "serve_summary"][-1]
+    assert summ["shed_total"] == shed
+    print(json.dumps({
+        "status": "serve_overload_ok", "offered": offered,
+        "shed": shed, "shed_rate": round(shed / float(offered), 4),
+        "p99_admitted_ms": round(p99_admitted * 1e3, 2),
+        "burn_alerts": len(alerts)}), file=sys.stderr)
 
 
 if __name__ == "__main__":

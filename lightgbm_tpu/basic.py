@@ -808,12 +808,22 @@ class Booster:
         requests coalesce into padded power-of-two batches that run
         through AOT-compiled per-bucket executables (zero steady-state
         recompiles), with ``pred_early_stop`` / ``pred_contrib`` served
-        from the same queue.  Configured from the booster's ``serve_*``
-        parameters (docs/Parameters.md); keyword ``overrides`` take
-        precedence (``max_batch``, ``max_delay_ms``, ``bucket_min``,
-        ``donate``, ``batch_event_every``, ``num_features``,
-        ``devices``).  Close it (or use as a context manager) to flush
-        the queue and stop the worker thread.
+        from the same queue.  Overload protection and SLO tracking ride
+        the same parameters: ``serve_queue_limit`` /
+        ``serve_request_deadline_ms`` shed doomed work at admission,
+        and the ``serve_slo_*`` targets drive the rolling SLO engine
+        whose burn-rate alerts route through the ``obs_health`` channel
+        (docs/Observability.md, "Serving observability & SLOs").
+
+        Configured from the booster's ``serve_*`` parameters
+        (docs/Parameters.md); keyword ``overrides`` take precedence
+        (``max_batch``, ``max_delay_ms``, ``bucket_min``, ``donate``,
+        ``batch_event_every``, ``queue_limit``,
+        ``request_deadline_ms``, ``request_event_every``,
+        ``slo_p99_ms``, ``slo_qps``, ``slo_window_s``, ``slo_every_s``,
+        ``slo_mode``, ``num_features``, ``devices``).  Close it (or use
+        as a context manager) to flush the queue, stop the worker
+        thread and leave the ``serve_summary`` lifetime record.
         """
         from .serve import ServingPredictor
         cfg = self._cfg
@@ -822,6 +832,18 @@ class Booster:
               "bucket_min": cfg.serve_bucket_min,
               "donate": cfg.serve_donate,
               "batch_event_every": cfg.serve_batch_event_every,
+              "queue_limit": cfg.serve_queue_limit,
+              "request_deadline_ms": cfg.serve_request_deadline_ms,
+              "request_event_every": cfg.serve_request_event_every,
+              "slo_p99_ms": cfg.serve_slo_p99_ms,
+              "slo_qps": cfg.serve_slo_qps,
+              "slo_window_s": cfg.serve_slo_window_s,
+              "slo_every_s": cfg.serve_slo_every_s,
+              # burn-rate alerts follow the training health channel's
+              # consequence mode; obs_health=off still WARNS (an SLO
+              # breach must never be silent once targets are set)
+              "slo_mode": (cfg.obs_health if cfg.obs_health != "off"
+                           else "warn"),
               "observer": self._gbdt._obs}
         kw.update(overrides)
         return ServingPredictor(self._gbdt, num_iteration=num_iteration,

@@ -30,10 +30,20 @@ data_profile   n_features (schema 5; obs/dataquality.py — per-feature
                missing rate / entropy / degeneracy flags, label balance)
 eval           it, results (schema 5; per-iteration eval-metric values,
                the convergence surface `obs explain` reads)
-serve_batch    route, rows, bucket (schema 6; serve/scheduler.py — one
-               coalesced microbatch: queue wait, execute time, pad rows)
+serve_batch    route, rows, bucket, pad, requests, queue_s, exec_s
+               (schema 6; serve/scheduler.py — one coalesced microbatch;
+               schema 7 declares the full field set it always carried)
 serve_bench    qps, p50_s, p99_s (schema 6; bench_serve.py — sustained
                load-generator summary, the gated serving metrics)
+serve_request  route, rows, bucket, spans (schema 7; serve/scheduler.py —
+               one sampled request trace: enqueue → coalesce-wait → pad →
+               execute → respond, with batch id and bucket)
+serve_slo      window_s, routes (schema 7; obs/serve.py — periodic
+               rolling-window SLO snapshot: per-route QPS and latency
+               quantiles, burn rates, alert state, target verdicts)
+serve_summary  batches, rows, shed_total (schema 7; serve/scheduler.py —
+               ServingPredictor lifetime totals emitted on close(), the
+               run_end of a serving session)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -69,11 +79,12 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
-# 3 (rank-less, no host_collective), 4 (no model/data events) and
-# 5 (no serving events) timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6)
+# 3 (rank-less, no host_collective), 4 (no model/data events),
+# 5 (no serving events) and 6 (no request traces / SLO snapshots)
+# timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -101,9 +112,20 @@ _REQUIRED = {
     "eval": ("it", "results"),
     # schema 6 (lightgbm_tpu/serve/): the serving tier — one coalesced
     # microbatch per serve_batch (sampled via serve_batch_event_every),
-    # one serve_bench summary per bench_serve.py measurement window
-    "serve_batch": ("route", "rows", "bucket"),
+    # one serve_bench summary per bench_serve.py measurement window.
+    # Schema 7 declares the full serve_batch field set (the scheduler
+    # always emitted pad/requests/queue_s/exec_s — the schema just
+    # under-promised), so strict validation and downstream tooling see
+    # every field; PR-6 timelines still validate.
+    "serve_batch": ("route", "rows", "bucket", "pad", "requests",
+                    "queue_s", "exec_s"),
     "serve_bench": ("qps", "p50_s", "p99_s"),
+    # schema 7 (obs/serve.py + serve/scheduler.py): serving-tier
+    # observability — sampled per-request trace spans, periodic
+    # rolling-window SLO snapshots, and the close-time lifetime summary
+    "serve_request": ("route", "rows", "bucket", "spans"),
+    "serve_slo": ("window_s", "routes"),
+    "serve_summary": ("batches", "rows", "shed_total"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -349,6 +371,12 @@ class NullObserver:
     def flight(self, reason, extra=None):
         pass
 
+    def add_flight_provider(self, fn):
+        pass
+
+    def remove_flight_provider(self, fn):
+        pass
+
     def iter_begin(self, it):
         pass
 
@@ -425,6 +453,7 @@ class RunObserver(NullObserver):
                         if self.events_path else None)
         self._ring = RingBuffer(flight_events)
         self._flight_dumped = False
+        self._flight_providers = []
         self._seq = 0
         self._clock = PhaseClock(fence_laps=(timing == "phase"))
         self._entries = EntryTimers()
@@ -570,6 +599,30 @@ class RunObserver(NullObserver):
         there is no events path to anchor the dump next to."""
         from .watchdog import dump_flight_record
         return dump_flight_record(self, reason, extra=extra)
+
+    def add_flight_provider(self, fn):
+        """Register a zero-arg callable returning a dict of live context
+        to merge into every flight record (serve/scheduler.py registers
+        its queue state here: depth, queued rows, pending routes).
+        Providers must be best-effort — a provider that raises is
+        skipped, never propagated into the dump."""
+        self._flight_providers.append(fn)
+
+    def remove_flight_provider(self, fn):
+        try:
+            self._flight_providers.remove(fn)
+        except ValueError:
+            pass
+
+    def flight_context(self):
+        """Merged provider dicts; forensics-grade best-effort."""
+        out = {}
+        for fn in list(self._flight_providers):
+            try:
+                out.update(fn() or {})
+            except Exception as e:
+                out.setdefault("provider_errors", []).append(repr(e))
+        return out
 
     @property
     def flight_path(self):

@@ -35,8 +35,10 @@ from ..utils.log import Log
 MODES = ("off", "warn", "fatal")
 
 # checks that never abort the run even under obs_health=fatal: a flat
-# loss is a tuning smell, not a poisoned run
-_WARN_ONLY = frozenset(("plateau",))
+# loss is a tuning smell, not a poisoned run, and an SLO burn-rate alert
+# (obs/serve.py) is a paging signal for operators — killing the server
+# that is already missing latency targets only makes the outage total
+_WARN_ONLY = frozenset(("plateau", "slo_burn_rate"))
 
 _PLATEAU_REL = 1e-4
 

@@ -329,3 +329,26 @@ def observe_serve_request(seconds):
     REGISTRY.histogram(
         "lgbm_serve_request_seconds",
         "per-request serving latency, submit to result").observe(seconds)
+
+
+def observe_serve_shed(route, reason):
+    """One request shed at admission (serve/scheduler.py overload
+    protection).  ``reason`` is ``queue_full`` (bounded queue at
+    ``serve_queue_limit``) or ``deadline`` (projected wait already
+    exceeds the request's deadline).  Labeled by route KIND only, same
+    cardinality discipline as observe_serve_batch."""
+    kind = route[0] if isinstance(route, tuple) and route else route
+    REGISTRY.counter(
+        "lgbm_serve_shed_total",
+        "requests rejected at admission by overload protection",
+        labels={"route": str(kind), "reason": str(reason)}).inc()
+
+
+def observe_serve_queue_age(seconds):
+    """Age of the oldest queued request (0 when the queue is empty) —
+    the gauge that makes a building backlog visible BEFORE shedding
+    starts; updated on every admission and batch pop."""
+    REGISTRY.gauge(
+        "lgbm_serve_queue_age_seconds",
+        "wait of the oldest request still in the microbatch queue").set(
+            round(float(seconds), 6))

@@ -17,6 +17,12 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   ``data_profile`` / ``importance`` / ``split_audit`` / ``eval`` events:
   suspicious-data findings, top-feature evolution, gain-margin summary
   and convergence; ``--check`` exits 1 on error-severity data findings;
+* ``serve RUN.jsonl``         — serving-tier report (obs/serve.py):
+  per-route latency table from sampled ``serve_request`` traces, SLO
+  verdicts and burn rates from ``serve_slo`` snapshots, shed/overload
+  summary and batch efficiency; ``--check`` exits 1 on any shed
+  request, fired burn-rate alert or failing SLO verdict — the CI gate
+  that non-overload load stays shed-free;
 * ``merge RUN.jsonl [-o M.jsonl]`` — discover the per-rank shards of a
   distributed run (``RUN.jsonl.r0`` ...), align them on iteration /
   collective ``seq`` (obs/merge.py), print per-collective barrier skew,
@@ -163,6 +169,14 @@ def timeline_metrics(events):
             out["stragglers"] = run_end["stragglers"]
         if "rank_report" in run_end:
             out["rank_report"] = run_end["rank_report"]
+    # serving timelines (bench_serve.py / ServingPredictor): fold the
+    # serve_* events into a headline so `obs summary` has a serving
+    # section instead of a zero-iteration shrug
+    if any(str(e.get("ev", "")).startswith("serve_") for e in events):
+        from .serve import serve_headline
+        head = serve_headline(events)
+        if head:
+            out["serve"] = head
     return out
 
 
@@ -189,7 +203,24 @@ def render_summary(events, out=None):
           "merge <shard>` for the cross-rank view")
     ips = (" (%.3f iters/sec)" % m["iters_per_sec"]
            if "iters_per_sec" in m else "")
-    w("iters %d  total %.3f s%s" % (m["iters"], m["total_s"], ips))
+    if m["iters"] or "serve" not in m:
+        w("iters %d  total %.3f s%s" % (m["iters"], m["total_s"], ips))
+    sv = m.get("serve")
+    if sv:
+        eff = ("  efficiency %.1f%%" % (100.0 * sv["batch_efficiency"])
+               if sv.get("batch_efficiency") is not None else "")
+        approx = " (sampled, lower bound)" if sv.get("sampled") else ""
+        w("serving: %d batches  %d rows%s%s"
+          % (sv["batches"], sv["rows"], eff, approx))
+        bits = []
+        if sv.get("qps") is not None:
+            bits.append("qps %s" % sv["qps"])
+        if sv.get("p99_s") is not None:
+            bits.append("p99 %.2f ms" % (1e3 * sv["p99_s"]))
+        bits.append("shed %d" % sv["shed_total"])
+        bits.append("burn alerts %d" % sv["alerts_fired"])
+        w("serving: " + "  ".join(bits)
+          + "  (obs serve for the full report)")
     totals = m.get("phase_totals") or {}
     tot = sum(totals.values())
     if totals and tot > 0:
@@ -520,6 +551,14 @@ def main(argv=None):
             p.add_argument("--check", action="store_true",
                            help="exit 1 on error-severity data-quality "
                                 "findings — the CI model-quality gate")
+    p = sub.add_parser("serve", help="serving-tier report: per-route "
+                                     "latency, SLO verdicts, shed/"
+                                     "overload summary, batch efficiency")
+    p.add_argument("timeline")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on shed requests, fired burn-rate "
+                        "alerts or failing SLO verdicts — the CI gate "
+                        "for non-overload load")
     p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
                                      "of per-rank shards")
     p.add_argument("shards", nargs="+",
@@ -570,6 +609,11 @@ def main(argv=None):
     elif args.cmd == "explain":
         bad = render_explain(events)
         if args.check and bad:
+            return 1
+    elif args.cmd == "serve":
+        from .serve import render_serve_report
+        problems = render_serve_report(events, check=args.check)
+        if args.check and problems:
             return 1
     elif args.cmd == "diff":
         render_diff(a, b)

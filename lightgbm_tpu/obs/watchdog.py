@@ -18,7 +18,16 @@ the rank's timeline shard (``<events_path>.flight.json``):
 * every Python thread's stack via ``sys._current_frames`` (the
   ``faulthandler``-style view, but structured);
 * live per-device memory stats and the current metrics-registry
-  snapshot.
+  snapshot;
+* any registered flight-context providers (``obs.add_flight_provider``)
+  — the serve scheduler reports queue depth, queued rows and pending
+  route kinds, so a wedged serve runner's dump shows what was stuck
+  behind it.
+
+The serve worker thread arms the same watchdog around every runner call
+(serve/scheduler.py), so a microbatch that never returns — a hung device
+call, a deadlocked host predictor — dumps the same flight record a hung
+collective would.
 
 The same dump fires on SIGTERM (the scheduler killing the job) and on
 ``obs_health=fatal`` aborts, so "the run died" always leaves a black
@@ -69,6 +78,17 @@ def dump_flight_record(obs, reason, label=None, extra=None):
     }
     if extra:
         record["extra"] = dict(extra)
+    # live-context providers (serve/scheduler.py: queue depth, queued
+    # rows, pending route kinds) — what the subsystem was holding when
+    # the run wedged, which the event ring alone cannot show
+    try:
+        ctx = obs.flight_context()
+    except AttributeError:
+        ctx = {}
+    except Exception as e:
+        ctx = {"error": repr(e)}
+    if ctx:
+        record["context"] = ctx
     try:
         from .memory import device_memory_stats
         record["devices"] = device_memory_stats()

@@ -12,10 +12,14 @@ architecture and capacity-planning guidance.
 * ``scheduler.py`` — ``MicrobatchScheduler`` / ``ServingPredictor``: an
   async coalescer that batches concurrent requests into padded
   power-of-two buckets under a max-latency deadline, with early-stop and
-  ``pred_contrib`` served through the same queue.
+  ``pred_contrib`` served through the same queue.  Overload protection
+  sheds at admission (bounded queue / per-request deadlines) and fails
+  shed futures fast with ``ServeOverloadError``; request traces, the
+  rolling SLO engine and burn-rate alerts live in ``obs/serve.py``.
 """
 from .executable import PredictExecutableCache, next_pow2
-from .scheduler import MicrobatchScheduler, ServingPredictor
+from .scheduler import (MicrobatchScheduler, ServeOverloadError,
+                        ServingPredictor)
 
 __all__ = ["MicrobatchScheduler", "PredictExecutableCache",
-           "ServingPredictor", "next_pow2"]
+           "ServeOverloadError", "ServingPredictor", "next_pow2"]

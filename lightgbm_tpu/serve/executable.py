@@ -149,6 +149,12 @@ class PredictExecutableCache:
         self.observer = observer if observer is not None else NULL_OBSERVER
         self._exe = {}                   # (bucket, convert) -> Compiled
         self._lock = threading.Lock()
+        # stage decomposition of the LAST predict_batch call (encode /
+        # pad / execute / convert seconds) — read by the serve worker
+        # right after the call to label request trace spans.  Worker-
+        # thread state, like the batch itself: concurrent predict_batch
+        # callers should not share one cache instance's spans.
+        self.last_spans = {}
         self.compiles = 0
         self._warm_compiles = None       # set by mark_warm()
         self._mesh_ctx = None
@@ -316,9 +322,14 @@ class PredictExecutableCache:
         """Host-side rank encoding of a normalized request block."""
         return dev_predict.rank_encode(self.rp, features)
 
-    def run_encoded(self, V, D, n: int, convert: bool = True) -> np.ndarray:
+    def run_encoded(self, V, D, n: int, convert: bool = True,
+                    spans=None) -> np.ndarray:
         """Score ``n`` encoded rows through the bucket executable:
-        pad to the bucket, execute, slice.  Returns (n, k) f64."""
+        pad to the bucket, execute, slice.  Returns (n, k) f64.
+        ``spans`` (a dict) accumulates the stage decomposition —
+        ``pad_s`` (bucket padding), ``execute_s`` (transfers + compiled
+        program), ``convert_s`` (host-side objective conversion)."""
+        t0 = time.perf_counter()
         bucket = self.bucket_for(n)
         exe = self.get(bucket, convert)
         pad = bucket - n
@@ -327,6 +338,7 @@ class PredictExecutableCache:
                 [V, np.zeros((pad, V.shape[1]), V.dtype)])
             D = np.concatenate(
                 [D, np.zeros((pad, D.shape[1]), D.dtype)])
+        t1 = time.perf_counter()
         if self._mesh_ctx is not None:
             rows_sh = self._mesh_ctx[2]
             Vd = jax.device_put(np.ascontiguousarray(V), rows_sh)
@@ -336,15 +348,25 @@ class PredictExecutableCache:
             Dd = jax.device_put(D, self.devices[0])
         out = np.asarray(jax.device_get(exe(self._dev, Vd, Dd))[:n],
                          np.float64)
+        t2 = time.perf_counter()
         if convert and self._conv == "host":
             out = np.asarray(self.objective.convert_output(
                 out if self.k > 1 else out[:, 0]), np.float64)
             out = out.reshape(n, self.k) if self.k == 1 else out
+        if spans is not None:
+            t3 = time.perf_counter()
+            spans["pad_s"] = spans.get("pad_s", 0.0) + (t1 - t0)
+            spans["execute_s"] = spans.get("execute_s", 0.0) + (t2 - t1)
+            if t3 - t2 > 0:
+                spans["convert_s"] = spans.get("convert_s", 0.0) \
+                    + (t3 - t2)
         return out
 
     def predict_batch(self, features, convert: bool = True) -> np.ndarray:
         """Normalize + encode + execute, chunking requests larger than
-        ``max_batch`` through the top bucket.  Returns (n, k) f64."""
+        ``max_batch`` through the top bucket.  Returns (n, k) f64.
+        Refreshes ``last_spans`` with this call's stage decomposition."""
+        spans = {}
         X = self.normalize(features)
         n = X.shape[0]
         out = np.empty((n, self.k), np.float64)
@@ -352,9 +374,13 @@ class PredictExecutableCache:
             part = X[lo:lo + self.max_batch]
             if part.shape[0] == 0:
                 break
+            te = time.perf_counter()
             V, D = self.encode(part)
+            spans["encode_s"] = spans.get("encode_s", 0.0) \
+                + (time.perf_counter() - te)
             out[lo:lo + part.shape[0]] = self.run_encoded(
-                V, D, part.shape[0], convert)
+                V, D, part.shape[0], convert, spans=spans)
+        self.last_spans = spans
         return out
 
     def stats(self) -> dict:

@@ -121,6 +121,13 @@ ALIAS_TABLE: Dict[str, str] = {
     "serve_min_bucket": "serve_bucket_min",
     "serve_donate_buffers": "serve_donate",
     "serve_batch_events": "serve_batch_event_every",
+    "serve_max_queue": "serve_queue_limit",
+    "serve_queue_max": "serve_queue_limit",
+    "serve_timeout_ms": "serve_request_deadline_ms",
+    "serve_request_events": "serve_request_event_every",
+    "serve_slo_p99": "serve_slo_p99_ms",
+    "serve_slo_window": "serve_slo_window_s",
+    "serve_slo_snapshot_every": "serve_slo_every_s",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -176,6 +183,10 @@ PARAMETER_SET = {
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
+    # serving observability & overload protection (obs/serve.py)
+    "serve_queue_limit", "serve_request_deadline_ms",
+    "serve_request_event_every", "serve_slo_p99_ms", "serve_slo_qps",
+    "serve_slo_window_s", "serve_slo_every_s",
 }
 
 _TRUE_SET = {"1", "true", "yes", "on", "+"}
@@ -587,6 +598,38 @@ class Config:
         # emit a `serve_batch` timeline event every Nth microbatch when
         # an observer is attached (0 = off; metrics always record)
         "serve_batch_event_every": ("int", 0),
+        # overload protection (serve/scheduler.py): bound the microbatch
+        # queue at this many requests; arrivals beyond it are shed at
+        # admission with ServeOverloadError (0 = unbounded).  Shedding
+        # is never silent: lgbm_serve_shed_total counts by route+reason
+        "serve_queue_limit": ("int", 0),
+        # default per-request latency budget: a request whose projected
+        # queue wait (coalescing delay + backlog batches x EWMA execute
+        # time) already exceeds it is shed at admission instead of
+        # queueing doomed work (0 = no deadline; per-request override
+        # via submit(deadline_ms=...)).  Distinct from serve_deadline_ms,
+        # which is the historical alias of the serve_max_delay_ms
+        # coalescing deadline
+        "serve_request_deadline_ms": ("float", 0.0),
+        # emit a `serve_request` trace event for every Nth completed
+        # request when an observer is attached: the request's latency
+        # decomposed into queue / encode / pad / execute / respond
+        # spans, with its batch id and bucket (0 = off)
+        "serve_request_event_every": ("int", 0),
+        # rolling-SLO targets (obs/serve.py SloEngine): p99 latency
+        # target in ms and sustained-QPS floor; 0 disables the target.
+        # Breaching the p99 budget (1% of requests may exceed the
+        # target) faster than 2x on BOTH burn windows fires a
+        # `slo_burn_rate` health event through the obs_health channel
+        "serve_slo_p99_ms": ("float", 0.0),
+        "serve_slo_qps": ("float", 0.0),
+        # long rolling window for SLO aggregation (the short burn
+        # window is window/6, SRE multi-window convention)
+        "serve_slo_window_s": ("float", 60.0),
+        # emit a `serve_slo` snapshot event every this many seconds
+        # when an observer is attached (0 = off; alert evaluation
+        # keeps its own cadence)
+        "serve_slo_every_s": ("float", 10.0),
     }
 
     # keys accepted for config-file compatibility whose behavior differs

@@ -1,0 +1,322 @@
+"""Serving-tier observability (lightgbm_tpu/obs/serve + the scheduler's
+overload protection).
+
+What production actually pages on: the rolling SLO engine's burn-rate
+alert must fire on a real breach and clear on recovery, admission
+control must shed with ``ServeOverloadError`` (never silently), sampled
+request traces must carry their span breakdown, and a wedged serve
+worker must leave a flight record naming the queue state it died
+holding."""
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import RunObserver, read_events
+from lightgbm_tpu.obs.events import SCHEMA_VERSION, validate_event
+from lightgbm_tpu.obs.serve import (SloEngine, render_serve_report,
+                                    route_kind, serve_metrics)
+from lightgbm_tpu.serve import MicrobatchScheduler, ServeOverloadError
+
+
+def _runner(route, feats):
+    return feats[:, :1] * 2.0
+
+
+class _Capture:
+    """Observer stub for SloEngine unit tests: records event() calls."""
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, ev, **kw):
+        self.events.append(dict(kw, ev=ev))
+
+    def flush(self):
+        pass
+
+
+# ------------------------------------------------------------- SLO engine
+def test_route_kind_collapses_tuples():
+    assert route_kind(("dev", True)) == "dev"
+    assert route_kind(("contrib", 28)) == "contrib"
+    assert route_kind("host") == "host"
+
+
+def test_burn_rate_alert_fires_and_clears():
+    obs = _Capture()
+    t = [0.0]
+    eng = SloEngine(observer=obs, mode="warn", p99_ms=10.0,
+                    window_s=6.0, every_s=1.0, clock=lambda: t[0])
+    # three seconds of requests ALL over the 10ms target: both burn
+    # windows hit 1.0/0.01 = 100x of the error budget
+    for sec in range(3):
+        t[0] = float(sec)
+        for _ in range(20):
+            eng.record(("dev", True), 0.5)
+    t[0] = 3.0
+    eng.evaluate(t[0])
+    assert eng.alerting and eng.alerts_fired == 1
+    fired = [e for e in obs.events if e["ev"] == "health"]
+    assert fired, "no health event on alert transition"
+    assert fired[0]["check"] == "slo_burn_rate"
+    assert fired[0]["status"] == "warn"        # warn-only, never fatal
+    assert fired[0]["detail"]["burn_long"] >= 2.0
+
+    # recovery: fast requests push the short-window burn under threshold
+    for sec in range(4, 12):
+        t[0] = float(sec)
+        for _ in range(20):
+            eng.record(("dev", True), 0.001)
+    t[0] = 12.0
+    eng.evaluate(t[0])
+    assert not eng.alerting and eng.alerts_cleared == 1
+    cleared = [e for e in obs.events if e["ev"] == "health"][-1]
+    assert cleared["status"] == "ok" and cleared["detail"]["cleared"]
+    # alert count never re-fired during recovery
+    assert eng.alerts_fired == 1
+    snaps = [e for e in obs.events if e["ev"] == "serve_slo"]
+    assert snaps and snaps[-1]["alert"] == "clear"
+
+
+def test_slo_snapshot_events_schema_valid(tmp_path):
+    path = str(tmp_path / "slo.jsonl")
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    t = [0.0]
+    eng = SloEngine(observer=obs, p99_ms=100.0, qps=1.0, window_s=12.0,
+                    every_s=1.0, clock=lambda: t[0])
+    for sec in range(3):
+        t[0] = float(sec)
+        for _ in range(20):
+            eng.record(("dev", True), 0.002)
+    eng.close()                     # forced final snapshot
+    obs.close()
+    evs = read_events(path)         # schema-validates every record
+    slos = [e for e in evs if e["ev"] == "serve_slo"]
+    assert slos
+    last = slos[-1]
+    assert last["verdicts"] == {"p99": "ok", "qps": "ok"}
+    assert last["routes"]["dev"]["n"] == 60
+    assert last["targets"] == {"p99_ms": 100.0, "qps": 1.0}
+
+
+# ------------------------------------------------------ overload protection
+def test_queue_limit_sheds_with_overload_error():
+    gate = threading.Event()
+
+    def runner(route, feats):
+        gate.wait(5.0)
+        return feats[:, :1]
+
+    sched = MicrobatchScheduler(runner, max_batch=8, max_delay_ms=1.0,
+                                queue_limit=2)
+    try:
+        # the first request wedges the worker inside the runner; the
+        # next two fill the bounded queue; the fourth must shed
+        first = sched.submit("r", np.zeros((1, 2)), 1)
+        time.sleep(0.1)
+        ok = [sched.submit("r", np.zeros((1, 2)), 1) for _ in range(2)]
+        shed = sched.submit("r", np.zeros((1, 2)), 1)
+        with pytest.raises(ServeOverloadError) as ei:
+            shed.result(timeout=1)
+        assert ei.value.reason == "queue_full"
+        gate.set()
+        first.result(timeout=5)
+        for f in ok:
+            f.result(timeout=5)
+    finally:
+        gate.set()
+        sched.close()
+    st = sched.stats()
+    assert st["shed"] == {"queue_full": 1} and st["shed_total"] == 1
+
+
+def test_deadline_shed_on_projected_wait():
+    with MicrobatchScheduler(_runner, max_batch=4,
+                             max_delay_ms=1.0) as sched:
+        # a COLD scheduler (no completed batch, EWMA unknown) must never
+        # deadline-shed on a guess, however tight the budget
+        sched.submit("r", np.zeros((1, 2)), 1,
+                     deadline_s=1e-6).result(timeout=5)
+        # now pretend batches take a second: a 0.5s budget is doomed
+        sched._ewma_exec_s = 1.0
+        doomed = sched.submit("r", np.zeros((1, 2)), 1, deadline_s=0.5)
+        with pytest.raises(ServeOverloadError) as ei:
+            doomed.result(timeout=1)
+        assert ei.value.reason == "deadline"
+        # a roomy budget is admitted and completes normally
+        sched.submit("r", np.zeros((1, 2)), 1,
+                     deadline_s=30.0).result(timeout=5)
+    st = sched.stats()
+    assert st["shed"] == {"deadline": 1}
+    assert st["requests"] == 2
+
+
+def test_shed_feeds_slo_engine():
+    eng = SloEngine(p99_ms=50.0, window_s=6.0, every_s=0.0)
+    gate = threading.Event()
+
+    def runner(route, feats):
+        gate.wait(5.0)
+        return feats[:, :1]
+
+    sched = MicrobatchScheduler(runner, max_batch=8, max_delay_ms=1.0,
+                                queue_limit=1, slo=eng)
+    try:
+        sched.submit("r", np.zeros((1, 2)), 1)
+        time.sleep(0.1)
+        sched.submit("r", np.zeros((1, 2)), 1)
+        with pytest.raises(ServeOverloadError):
+            sched.submit("r", np.zeros((1, 2)), 1).result(timeout=1)
+    finally:
+        gate.set()
+        sched.close()
+    overall = eng.evaluate()
+    assert overall["shed"] == 1
+
+
+# ------------------------------------------------------------ request traces
+def test_request_trace_events_sampled(tmp_path):
+    path = str(tmp_path / "serve.jsonl")
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    with MicrobatchScheduler(_runner, max_batch=4, max_delay_ms=1.0,
+                             observer=obs,
+                             request_event_every=2) as sched:
+        futs = [sched.submit(("dev", True), np.zeros((1, 2)), 1)
+                for _ in range(8)]
+        for f in futs:
+            f.result(timeout=5)
+    obs.close()
+    evs = read_events(path)
+    reqs = [e for e in evs if e["ev"] == "serve_request"]
+    assert len(reqs) == 4           # every 2nd of 8 requests
+    for e in reqs:
+        assert e["kind"] == "dev"
+        assert e["bucket"] >= e["rows"] == 1
+        assert {"queue_s", "exec_s", "respond_s"} <= set(e["spans"])
+        assert e["total_s"] >= e["spans"]["queue_s"]
+
+
+def test_serve_batch_event_requires_full_field_set():
+    rec = {"ev": "serve_batch", "run": "x", "t": 0.0,
+           "schema": SCHEMA_VERSION, "route": "('dev', True)",
+           "kind": "dev", "rows": 4, "bucket": 8, "pad": 4,
+           "requests": 2, "queue_s": 0.001, "exec_s": 0.002}
+    validate_event(rec, strict=True)
+    for key in ("queue_s", "exec_s", "pad", "requests"):
+        bad = dict(rec)
+        bad.pop(key)
+        with pytest.raises(ValueError):
+            validate_event(bad, strict=True)
+
+
+# -------------------------------------------------- watchdog + flight record
+def test_watchdog_flight_record_from_wedged_serve_worker(tmp_path):
+    path = str(tmp_path / "serve.jsonl")
+    obs = RunObserver(events_path=path, watchdog_secs=0.15)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    release = threading.Event()
+
+    # the fault-injection hook wedges the batch INSIDE the armed window,
+    # exactly like a hung device call would
+    def fault(route, batch):
+        release.wait(5.0)
+
+    sched = MicrobatchScheduler(_runner, max_batch=8, max_delay_ms=1.0,
+                                observer=obs, fault_hook=fault)
+    fp = path + ".flight.json"
+    try:
+        wedged = sched.submit(("dev", True), np.zeros((2, 3)), 2)
+        # different-route requests cannot coalesce into the wedged
+        # batch: they stay queued, so the flight record has pending
+        # state to show
+        extra = [sched.submit(("host",), np.zeros((1, 3)), 1)
+                 for _ in range(3)]
+        deadline = time.monotonic() + 10.0
+        while not os.path.exists(fp) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert os.path.exists(fp), "watchdog never dumped flight record"
+        release.set()
+        wedged.result(timeout=5)
+        for f in extra:
+            f.result(timeout=5)
+    finally:
+        release.set()
+        sched.close()
+        obs.close()
+    with open(fp) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "watchdog timeout"
+    assert "serve batch route=dev rows=2" in rec["label"]
+    serve_ctx = rec["context"]["serve"]
+    assert serve_ctx["queue_depth"] == 3
+    assert serve_ctx["pending_routes"] == {"host": 3}
+    assert serve_ctx["oldest_wait_s"] >= 0.0
+
+
+def test_flight_provider_registry_merges_and_survives_errors(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "t.jsonl"))
+
+    def good():
+        return {"mine": {"depth": 7}}
+
+    def bad():
+        raise RuntimeError("provider exploded")
+
+    obs.add_flight_provider(good)
+    obs.add_flight_provider(bad)
+    ctx = obs.flight_context()
+    assert ctx["mine"] == {"depth": 7}
+    assert ctx["provider_errors"]
+    obs.remove_flight_provider(good)
+    obs.remove_flight_provider(bad)
+    assert obs.flight_context() == {}
+    obs.close()
+
+
+# ------------------------------------------------------- reader + CLI gate
+def _serve_timeline(tmp_path, name="ok.jsonl"):
+    path = str(tmp_path / name)
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    with MicrobatchScheduler(_runner, max_batch=4, max_delay_ms=1.0,
+                             observer=obs, batch_event_every=1,
+                             request_event_every=1) as sched:
+        futs = [sched.submit(("dev", True), np.zeros((1, 2)), 1)
+                for _ in range(6)]
+        for f in futs:
+            f.result(timeout=5)
+    obs.close()
+    return path
+
+
+def test_serve_metrics_and_report_on_clean_timeline(tmp_path):
+    evs = read_events(_serve_timeline(tmp_path))
+    m = serve_metrics(evs)
+    assert m["present"]
+    assert m["totals"]["sampled"] is True      # no serve_summary record
+    assert m["totals"]["rows"] == 6
+    assert m["routes"]["dev"]["n"] == 6
+    assert m["batch_routes"]["dev"]["rows"] == 6
+    buf = io.StringIO()
+    assert render_serve_report(evs, out=buf, check=True) == []
+    assert "verdict: PASS" in buf.getvalue()
+
+
+def test_obs_serve_cli_check_exit_codes(tmp_path):
+    from lightgbm_tpu.obs.query import main as obs_main
+    ok = _serve_timeline(tmp_path)
+    assert obs_main(["serve", ok, "--check"]) in (0, None)
+    # a timeline with NO serving events must fail the gate loudly
+    empty = str(tmp_path / "train_only.jsonl")
+    obs = RunObserver(events_path=empty)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.close()
+    assert obs_main(["serve", empty, "--check"]) == 1

@@ -27,7 +27,8 @@ Usage:
     python tools/bench_compare.py BASELINE CANDIDATE \
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
         [--tol-recompile 0] [--tol-eval 0.02] \
-        [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] [--json]
+        [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] \
+        [--tol-serve-shed 0.25] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -65,6 +66,11 @@ METRICS = {
     # separately — a QPS win that blows up p99 is not a win
     "serve_qps": (+1, 0.15),
     "serve_p99_s": (-1, 0.30),
+    # fraction of offered requests shed at admission (overload
+    # protection).  Zero-baseline rule applies: a non-overload baseline
+    # sheds nothing, so ANY shedding in the candidate is a regression;
+    # overload-vs-overload runs tolerate 25% load-generator noise
+    "serve_shed_rate": (-1, 0.25),
 }
 
 
@@ -121,6 +127,8 @@ def _from_timeline(events):
     if serve:
         out["serve_qps"] = float(serve[-1]["qps"])
         out["serve_p99_s"] = float(serve[-1]["p99_s"])
+        if serve[-1].get("shed_rate") is not None:
+            out["serve_shed_rate"] = float(serve[-1]["shed_rate"])
     return out
 
 
@@ -139,6 +147,8 @@ def _from_parsed(parsed):
         out["serve_qps"] = float(parsed["serve_qps"])
     if parsed.get("serve_p99_s") is not None:
         out["serve_p99_s"] = float(parsed["serve_p99_s"])
+    if parsed.get("serve_shed_rate") is not None:
+        out["serve_shed_rate"] = float(parsed["serve_shed_rate"])
     return out
 
 
@@ -234,6 +244,10 @@ def main(argv=None):
     ap.add_argument("--tol-serve-p99", type=float, default=METRICS[
         "serve_p99_s"][1],
         help="serving p99-latency relative tolerance")
+    ap.add_argument("--tol-serve-shed", type=float, default=METRICS[
+        "serve_shed_rate"][1],
+        help="serving shed-rate relative tolerance (a zero-shed "
+             "baseline fails on ANY candidate shedding)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
@@ -242,7 +256,8 @@ def main(argv=None):
             "recompile_count": args.tol_recompile,
             "final_eval_metric": args.tol_eval,
             "serve_qps": args.tol_serve_qps,
-            "serve_p99_s": args.tol_serve_p99}
+            "serve_p99_s": args.tol_serve_p99,
+            "serve_shed_rate": args.tol_serve_shed}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

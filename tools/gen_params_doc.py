@@ -174,6 +174,33 @@ NOTES = {
                             "importance_history()",
     "obs_importance_topk": "features kept per importance event "
                            "(<=0 = all used features)",
+    "serve_max_batch": "serving tier: max rows per coalesced microbatch "
+                       "(and the top executable bucket)",
+    "serve_max_delay_ms": "max coalescing wait for the oldest queued "
+                          "request before the batch flushes",
+    "serve_bucket_min": "smallest AOT executable bucket (power-of-two "
+                        "ladder up to serve_max_batch)",
+    "serve_donate": "auto / true / false — donate input buffers to the "
+                    "serve executables (auto = non-CPU backends)",
+    "serve_batch_event_every": "emit every Nth microbatch as a "
+                               "serve_batch timeline event (0 = off)",
+    "serve_queue_limit": "overload protection: max queued requests "
+                         "before admission sheds with "
+                         "ServeOverloadError (0 = unbounded)",
+    "serve_request_deadline_ms": "default per-request latency budget: "
+                                 "admission sheds when the projected "
+                                 "wait already exceeds it (0 = off)",
+    "serve_request_event_every": "emit every Nth completed request as a "
+                                 "serve_request trace event with its "
+                                 "span breakdown (0 = off)",
+    "serve_slo_p99_ms": "p99 latency target for the rolling SLO engine "
+                        "+ burn-rate alerts (0 = no target)",
+    "serve_slo_qps": "minimum-QPS target for the SLO verdicts "
+                     "(0 = no target)",
+    "serve_slo_window_s": "long SLO aggregation window; the burn "
+                          "alert's short window is 1/6th of it",
+    "serve_slo_every_s": "serve_slo snapshot cadence in seconds "
+                         "(0 = snapshots off)",
     "obs_data_profile": "profile the binning sample at Dataset "
                         "construction (missing rates, bin-occupancy "
                         "entropy, constant/near-constant/ID-like "
@@ -229,6 +256,12 @@ GROUPS = [
         "obs_straggler_warn_skew", "obs_watchdog_secs", "obs_fsync",
         "obs_flight_events", "obs_split_audit", "obs_importance_every",
         "obs_importance_topk", "obs_data_profile"]),
+    ("Serving", [
+        "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
+        "serve_donate", "serve_batch_event_every", "serve_queue_limit",
+        "serve_request_deadline_ms", "serve_request_event_every",
+        "serve_slo_p99_ms", "serve_slo_qps", "serve_slo_window_s",
+        "serve_slo_every_s"]),
 ]
 
 

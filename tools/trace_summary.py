@@ -71,6 +71,10 @@ def summarize_jsonl(path, csv=False, out=None):
     health = [e for e in events if e["ev"] == "health"]
     metric_evs = [e for e in events if e["ev"] == "metrics"]
     scrape = metric_evs[-1]["scrape"] if metric_evs else {}
+    serve = None
+    if any(e["ev"].startswith("serve_") for e in events):
+        from lightgbm_tpu.obs import serve as obs_serve
+        serve = obs_serve.serve_metrics(events)
 
     if csv:
         w = out.write
@@ -97,6 +101,15 @@ def summarize_jsonl(path, csv=False, out=None):
             else:
                 w("metric,%s,%.6f,,1,%s\n"
                   % (name, float(m["value"]), m.get("type", "")))
+        if serve and serve.get("present"):
+            t = serve["totals"]
+            w("serve_total,all,,,%d,rows=%d pad=%d shed=%d sampled=%d\n"
+              % (t["batches"], t["rows"], t["pad_rows"],
+                 t["shed_total"], int(t["sampled"])))
+            for k, r in sorted(serve.get("routes", {}).items()):
+                w("serve_route,%s,%.6f,%.6f,%d,p99_s=%.6f\n"
+                  % (k, r.get("mean_s", 0.0) * r["n"],
+                     r.get("mean_s", 0.0), r["n"], r.get("p99_s", 0.0)))
         return
 
     w = lambda s="": out.write(s + "\n")
@@ -117,16 +130,20 @@ def summarize_jsonl(path, csv=False, out=None):
         w("learner: %s" % (", ".join(
             "%s=%s" % (k, ctx[k]) for k in sorted(ctx))))
     fenced = all(e.get("fenced") for e in iters) if iters else False
-    w("\n== per-phase time over %d iterations (%s) ==" % (
-        len(iters), "fenced" if fenced else "dispatch-only — NOT "
-        "device-accurate (obs_timing=off)"))
-    w("  %10s %10s %7s  %s" % ("total_s", "mean_ms", "share", "phase"))
-    for k, v in phase_totals.most_common():
-        w("  %10.3f %10.2f %6.1f%%  %s"
-          % (v, 1e3 * v / max(len(iters), 1),
-             100.0 * v / total_s if total_s else 0.0, k))
-    w("  %10.3f %10.2f %7s  total" % (
-        total_s, 1e3 * total_s / max(len(iters), 1), ""))
+    if iters or not (serve and serve.get("present")):
+        # serve-only timelines have no training iterations — skip the
+        # empty phase table instead of printing a 0-iteration header
+        w("\n== per-phase time over %d iterations (%s) ==" % (
+            len(iters), "fenced" if fenced else "dispatch-only — NOT "
+            "device-accurate (obs_timing=off)"))
+        w("  %10s %10s %7s  %s" % ("total_s", "mean_ms", "share",
+                                   "phase"))
+        for k, v in phase_totals.most_common():
+            w("  %10.3f %10.2f %6.1f%%  %s"
+              % (v, 1e3 * v / max(len(iters), 1),
+                 100.0 * v / total_s if total_s else 0.0, k))
+        w("  %10.3f %10.2f %7s  total" % (
+            total_s, 1e3 * total_s / max(len(iters), 1), ""))
 
     if entries or compiles:
         w("\n== compile vs execute per jitted entry point ==")
@@ -185,6 +202,34 @@ def summarize_jsonl(path, csv=False, out=None):
         w("\n== peak device memory ==")
         for did, b in sorted(peaks.items()):
             w("  device %d: %.1f MiB" % (did, b / 2**20))
+
+    if serve and serve.get("present"):
+        t = serve["totals"]
+        eff = ("%.1f%%" % (100.0 * t["batch_efficiency"])
+               if t["batch_efficiency"] is not None else "-")
+        w("\n== serving (%s totals) =="
+          % ("sampled, lower bound" if t["sampled"] else "exact"))
+        w("  batches %s  rows %s  batch efficiency %s  shed %s"
+          % (t["batches"], t["rows"], eff, t["shed_total"]))
+        for k in sorted(serve.get("routes", {})):
+            r = serve["routes"][k]
+            fmt = lambda v: "-" if v is None else "%.2f" % (1e3 * v)
+            w("  route %-10s n=%-6d p50 %s ms  p95 %s ms  p99 %s ms"
+              % (k, r["n"], fmt(r.get("p50_s")), fmt(r.get("p95_s")),
+                 fmt(r.get("p99_s"))))
+        slo = serve.get("slo")
+        if slo:
+            ov = slo.get("overall") or {}
+            w("  last SLO window: qps %.1f  p99 %s ms  err %.3f%%"
+              % (float(ov.get("qps", 0.0) or 0.0),
+                 "-" if ov.get("p99_s") is None
+                 else "%.2f" % (1e3 * float(ov["p99_s"])),
+                 100.0 * float(ov.get("error_rate", 0.0) or 0.0)))
+        al = serve["alerts"]
+        w("  slo burn-rate alerts: %d fired / %d cleared%s"
+          % (al["fired"], al["cleared"],
+             "  [ACTIVE]" if al["active"] else ""))
+        w("  (full report: python -m lightgbm_tpu obs serve <timeline>)")
 
     if health:
         hc = collections.Counter((e["check"], e["status"]) for e in health)
