@@ -490,10 +490,29 @@ def dry():
             "metrics snapshot missing %r" % need
     end = [e for e in evs if e["ev"] == "run_end"][-1]
     assert end.get("status") == "ok", "clean dry run must end status=ok"
+    # exactly ONE kernel-selection decision per learner construction,
+    # made before training starts (a mid-run re-tune would recompile
+    # the grow executable under the boosting loop) and — with
+    # tpu_autotune=off, the CPU-CI default — zero probe waves
+    decs = [e for e in evs if e["ev"] == "autotune_decision"]
+    assert len(decs) == 1, \
+        "expected exactly one autotune_decision per learner, got %d" \
+        % len(decs)
+    assert decs[0]["mode"] == "off" and decs[0]["source"] == "off", \
+        "dry run defaults must resolve tpu_autotune=off, got %s/%s" \
+        % (decs[0]["mode"], decs[0]["source"])
+    probes = [e for e in evs if e["ev"] == "autotune_probe"]
+    assert not probes, "tpu_autotune=off must not probe, found %d" \
+        % len(probes)
+    first_iter_t = min(e["t"] for e in iter_recs)
+    assert all(e["t"] <= first_iter_t for e in decs), \
+        "autotune_decision after the first iteration (mid-run re-tune)"
     print(json.dumps({"status": "dry_ok", "events": len(evs),
                       "iters": len(iter_recs), "health": len(health),
                       "metrics": len(metric_recs),
-                      "compile_attr": len(attr), "path": obs_path}))
+                      "compile_attr": len(attr),
+                      "autotune_decisions": len(decs),
+                      "path": obs_path}))
 
 
 if __name__ == "__main__":

@@ -44,6 +44,16 @@ serve_slo      window_s, routes (schema 7; obs/serve.py — periodic
 serve_summary  batches, rows, shed_total (schema 7; serve/scheduler.py —
                ServingPredictor lifetime totals emitted on close(), the
                run_end of a serving session)
+autotune_probe cell, s_per_wave (schema 8; ops/autotune.py — one
+               microbenched candidate kernel cell with its measured
+               seconds per wave)
+autotune_decision mode, source, cell (schema 8; ops/autotune.py — the
+               kernel-selection decision for one learner construction:
+               chosen cell vs the heuristic prior, every probed cell's
+               s/wave, winner margin, probe overhead, cache hit/path)
+wave_band_escape width_from, width_to (schema 8; ops/learner.py — the
+               auto wave width escaped the measured pathological
+               hist-block band; previously silent, BENCH_NOTES.md)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -79,12 +89,12 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
-# 5 (no serving events) and 6 (no request traces / SLO snapshots)
-# timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
+# 5 (no serving events), 6 (no request traces / SLO snapshots) and
+# 7 (no autotune/band-escape events) timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -126,6 +136,13 @@ _REQUIRED = {
     "serve_request": ("route", "rows", "bucket", "spans"),
     "serve_slo": ("window_s", "routes"),
     "serve_summary": ("batches", "rows", "shed_total"),
+    # schema 8 (ops/autotune.py + ops/learner.py): measured kernel
+    # selection — per-cell probe timings, the per-learner decision
+    # (with prior, margin and cache provenance), and the previously
+    # silent pathology-band width escape
+    "autotune_probe": ("cell", "s_per_wave"),
+    "autotune_decision": ("mode", "source", "cell"),
+    "wave_band_escape": ("width_from", "width_to"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 

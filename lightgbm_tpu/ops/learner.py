@@ -83,104 +83,13 @@ def build_bundle_arrays(train_data: TrainingData):
     return arrays, Bg
 
 
-def _order_sensitive(config: Config) -> bool:
-    """Configs whose quality measurably depends on the leaf-wise split
-    ORDER (PARITY_TRAINING.md: lambdarank NDCG; DART/GOSS/InfiniteBoost
-    compound the approximation through tree re-weighting / sampling)."""
-    return (str(config.objective) in ("lambdarank", "rank")
-            or str(config.boosting_type) in ("dart", "goss", "infinite",
-                                             "infiniteboost"))
-
-
-def resolve_wave_order(config: Config) -> str:
-    """tpu_wave_order: auto -> 'exact' where order matters (those configs
-    then keep wave-width speed WITH the reference's split sequence),
-    'batched' otherwise (proven quality parity at full speed)."""
-    v = str(config.tpu_wave_order).strip().lower()
-    if v not in ("auto", "batched", "exact"):
-        Log.fatal("Unknown tpu_wave_order %s (expected auto/batched/"
-                  "exact)", v)
-    if v != "auto":
-        return v
-    return "exact" if _order_sensitive(config) else "batched"
-
-
-def resolve_wave_width(config: Config, num_leaves: int,
-                       wave_order: str = "batched") -> int:
-    """tpu_wave_width=-1 -> auto: scale the wave to the frontier size,
-    gated on QUALITY, not only speed.
-
-    Speed (v5e, 1M x 28, BENCH_NOTES.md): W=16 is fastest at 63 leaves,
-    W=32 at 255 — bigger waves amortize the per-sweep pass over more
-    splits, but at small trees they just pad the frontier.
-
-    Quality (PARITY_TRAINING.md): BATCHED frontiers approximate the
-    leaf-wise split ORDER; at W=8 the measured deltas vs the reference
-    are within ~1e-3 for plain-GBDT binary/multiclass metrics but
-    -6.4e-3 NDCG@10 on lambdarank (ranking gains are order-sensitive)
-    and +0.9e-2..+3e-2 logloss under DART/GOSS/InfiniteBoost (their
-    tree re-weighting / gradient sampling compounds the order
-    approximation).  Those configs auto-resolve to tpu_wave_order=exact
-    (which reproduces the leaf-wise sequence bit-for-bit at any W,
-    tests/test_wave_exact_order.py) and KEEP the width ladder; under an
-    explicit tpu_wave_order=batched they fall back to W=1.  Explicit
-    user widths always pass through.
-    """
-    w = int(config.tpu_wave_width)
-    if w > 0:
-        return w
-    if w != -1:
-        Log.fatal("tpu_wave_width must be positive or -1 (auto), got %d", w)
-    if _order_sensitive(config) and wave_order != "exact":
-        # batched waves approximate the split order — these configs pay
-        # W=1 unless the exact-order schedule carries them
-        return 1
-    if num_leaves <= 31:
-        return 8
-    if num_leaves <= 127:
-        return 16
-    return 32
-
-
-# the VMEM budget the Pallas wave kernels compile under, shared with the
-# auto hist-mode gate (64 MB of the kernels' 100 MB compiler limit so
-# input tiles and temporaries fit too)
-_WAVE_VMEM_GATE = 64 << 20
-
-# Mid-size accumulator-block pathology, measured on v5e (BENCH_NOTES.md,
-# r4): hist blocks of ~17-25 MB run 10-43x slower than the same shape
-# one width tier up (~34-49 MB) — epsilon forced-W16 19.1 s/iter vs W32
-# 0.45; bosch dense W32 9.75 vs W64 0.90; yahoo's 2.1x headline sits at
-# a 17 MB W32 cell.  Root cause unconfirmed (suspect: Mosaic scheduling
-# of mid-size out blocks, ops/pallas_wave.py::_tile_plan); until a trace
-# lands, auto widths BUMP OUT of the band when the doubled block still
-# compiles.  Bounds are deliberately wide of the measured cells.
-# Round-5 narrowing (pre-registered rule, BENCH_NOTES.md "Armed
-# decks"): yahoo's 17.2 MB W=32 cell escaped to W=64 under the original
-# (12 MB, 30 MB) band and measured 3.2x SLOWER (22.5 vs 7.06 s/iter,
-# tools/BENCH_SUITE.md yahoo_w64) — so the lower bound moves past it.
-# Bosch's 23.8 MB W=32 cell (the data-backed escape: W=64 was 10.8x
-# faster) stays inside.
-_HIST_BLOCK_BAND = (18 << 20, 30 << 20)
-
-
-def band_adjusted_width(width: int, ncols: int, bin_pad: int) -> int:
-    """Auto-width escape from the pathological hist-block band: double W
-    (up to 64) while the (ncols*bin_pad, 3W) f32 accumulator block lands
-    inside the measured slow band and the doubled block stays within the
-    kernels' VMEM gate.  Explicit user widths never pass through here,
-    and neither does the order-sensitivity W=1 pin (resolve_wave_width's
-    quality gate for DART/GOSS/lambdarank under batched order) — a
-    speed escape must not undo a quality decision."""
-    if width <= 1:
-        return width
-    lo, hi = _HIST_BLOCK_BAND
-    block = ncols * bin_pad * 12 * width
-    while (lo <= block < hi and width < 64
-           and block * 2 <= _WAVE_VMEM_GATE):
-        width *= 2
-        block *= 2
-    return width
+# kernel-selection policy now lives in ops/autotune.py (the measured
+# autotuner's PRIOR); re-exported here because tests and downstream
+# code import the resolvers from the learner module
+from .autotune import (HIST_BLOCK_BAND as _HIST_BLOCK_BAND,
+                       WAVE_VMEM_GATE as _WAVE_VMEM_GATE,
+                       _order_sensitive, band_adjusted_width,
+                       resolve_wave_order, resolve_wave_width)
 
 
 def build_split_params(config: Config) -> SplitParams:
@@ -211,6 +120,11 @@ class SerialTreeLearner:
         4-bit packed (0 = unpacked)."""
         self.config = config
         self.train_data = train_data
+        # schema events produced during construction (band escapes,
+        # autotune probes/decision) — the observer is attached AFTER
+        # construction (gbdt.py _reset_observer), so they queue here
+        # and set_observer flushes them right after the run header
+        self._pending_events = []
         self.num_leaves = config.num_leaves
         self.dtype = jnp.float64 if config.tpu_use_dp else jnp.float32
         self.num_bins = int(train_data.num_bin_arr.max()) if train_data.num_features else 2
@@ -244,68 +158,16 @@ class SerialTreeLearner:
         nbins = self.group_bins if train_data.bundle is not None \
             else self.num_bins
         if hist_mode == "auto":
-            # measured on v5e (1M x 28, varying inputs to defeat dispatch
-            # dedup): onehot 7.2ms/25.6ms at B=63/255 vs scatter 226ms at
-            # either — XLA's fused one-hot reduce is at the VPU roofline,
-            # scatter-add serializes.  On CPU the opposite holds.
-            on_tpu = jax.default_backend() == "tpu"
-            # On-chip A/B at the 255-leaf recipe (tools/AB_RESULTS.md,
-            # 1M x 28): the transposed Pallas wave kernel (one-hot
-            # generated in VMEM, MXU-native dot) beats the XLA one-hot
-            # engine 6.60 vs 5.56 it/s — and the gap widens with N as the
-            # materialized one-hot's HBM floor grows.  auto therefore
-            # picks it whenever the wave engine will actually run it:
-            # TPU, f32 accumulation (the kernels are single-dtype), the
-            # dense store, a learner whose engine is the wave schedule
-            # (serial/data; voting+feature run the exact engine), and a
-            # shape whose VMEM-resident histogram block leaves headroom
-            # inside the kernels' 100 MB compiler budget — the gate uses
-            # 64 MB so input tiles/temporaries fit too (the A/B covered
-            # 28 cols x 63 bins; a Bosch-wide 968 x 256-pad block would
-            # NOT compile — those shapes keep the HBM-streaming onehot
-            # engine).
-            wave_capable = (
-                str(config.tpu_growth) in ("auto", "wave")
-                and not config.tpu_use_dp
-                and not config.tpu_sparse
-                and str(config.tree_learner) in ("serial", "data",
-                                                 "data_parallel"))
-            # width only resolved (and validated) when the wave engine
-            # will actually run — off-TPU growth resolves to exact here
-            # and a garbage tpu_wave_width must keep training (ADVICE r2)
-            vmem_hist_bytes = (ncols * _bin_pad(nbins) * 3 * 4
-                               * resolve_wave_width(
-                                   config, self.num_leaves,
-                                   resolve_wave_order(config))
-                               if on_tpu and wave_capable else 0)
-            if on_tpu and wave_capable and vmem_hist_bytes <= 64 << 20:
-                # v5 fused kernel promotion (round-4 on-chip A/Bs): at
-                # the narrow-F recipe pallas_ct beats pallas_t at BOTH
-                # measured shapes — 1.30 vs 1.16 it/s at the 10.5M x 28
-                # flagship (tools/BENCH_SUITE.md higgs_ct) and 11.66 vs
-                # 10.92 at 1M x 28 (tools/AB_RESULTS.md) — by fusing the
-                # partition sweep into the histogram kernel (ONE Xt read
-                # per wave).  Wide-F shapes keep pallas_t until ct has
-                # on-chip datapoints there (epsilon/msltr ct arms are
-                # queued; the forced-W=16 epsilon pathology shows wide-F
-                # cells can surprise, BENCH_NOTES.md).  Both ct
-                # measurements are single-chip serial arms, so the
-                # promotion is scoped to serial EXECUTION — psum_axis is
-                # None, which includes data configs falling back to the
-                # serial engine on one device (ADVICE r4); the true DP
-                # learner keeps pallas_t until a DP A/B lands.
-                # Round-5 widening (tools/BENCH_SUITE.md 15:50 block):
-                # ct won 15% at expo_cat (40 x 64-pad = 2560, 4.07 vs
-                # 3.53 it/s) so the bound moves to that measured shape.
-                # It is NOT widened further: msltr's 0.68-vs-0.66 is
-                # within noise, and epsilon (2000 x 64 = 128000) LOSES
-                # 5.6x (0.40 vs 2.23) — wide-F keeps pallas_t.
-                hist_mode = ("pallas_ct"
-                             if ncols * _bin_pad(nbins) <= 2560
-                             and psum_axis is None
-                             else "pallas_t")
-            else:
-                hist_mode = "onehot" if on_tpu else "scatter"
+            # the measured-heuristic PRIOR (ops/autotune.py
+            # prior_hist_mode, with the chip-session provenance in its
+            # docstring): pallas_ct / pallas_t where the wave engine
+            # will run with VMEM headroom, onehot on TPU otherwise,
+            # scatter on CPU.  In measure/force autotune modes the
+            # decide() block below may override this with a probed
+            # winner for the shape bucket.
+            from .autotune import prior_hist_mode
+            hist_mode = prior_hist_mode(config, ncols, _bin_pad(nbins),
+                                        self.num_leaves, psum_axis)
         self.hist_mode = hist_mode
         self.cache_hists = hist_cache_enabled(
             config, self.num_leaves, ncols, nbins,
@@ -407,12 +269,35 @@ class SerialTreeLearner:
                                               self.wave_order)
                            if growth == "wave" else 1)
         if growth == "wave" and int(config.tpu_wave_width) == -1:
+            from .wave import hist_block_bytes
             from .wave import pallas_wave_active as _pwa
             if _pwa(self.hist_mode, self.dtype):
                 # escape the measured mid-size accumulator-block
-                # pathology (band_adjusted_width) — auto widths only
+                # pathology (band_adjusted_width) — auto widths only.
+                # An escape is a silent perf decision no longer: it
+                # warns and lands on the timeline (wave_band_escape,
+                # schema v8) so the pathology band is visible in
+                # telemetry, not only in BENCH_NOTES.md.
+                w0 = self.wave_width
                 self.wave_width = band_adjusted_width(
-                    self.wave_width, ncols, _bin_pad(nbins))
+                    w0, ncols, _bin_pad(nbins))
+                if self.wave_width != w0:
+                    lo, hi = _HIST_BLOCK_BAND
+                    Log.warning(
+                        "auto wave width escaped the pathological "
+                        "hist-block band: W=%d -> W=%d (block %.1f MB "
+                        "in the measured %d-%d MB slow band, "
+                        "BENCH_NOTES.md)", w0, self.wave_width,
+                        hist_block_bytes(ncols, _bin_pad(nbins), w0)
+                        / (1 << 20), lo >> 20, hi >> 20)
+                    self._pending_events.append(("wave_band_escape", {
+                        "width_from": int(w0),
+                        "width_to": int(self.wave_width),
+                        "block_mb": round(hist_block_bytes(
+                            ncols, _bin_pad(nbins), w0) / (1 << 20), 2),
+                        "band_lo_mb": lo >> 20, "band_hi_mb": hi >> 20,
+                        "ncols": int(ncols),
+                        "bin_pad": int(_bin_pad(nbins))}))
         if bool(config.tpu_wave_compact):
             from .wave import pallas_wave_active as _pwa2
             if not (growth == "wave"
@@ -432,28 +317,19 @@ class SerialTreeLearner:
         if hp not in ("auto", "hilo", "bf16"):
             Log.fatal("Unknown tpu_hist_precision %s (expected auto/"
                       "hilo/bf16)", config.tpu_hist_precision)
-        # applies only where the Pallas wave kernels run.  Round-5
-        # promotion (pre-registered rule, BENCH_NOTES.md "Armed decks";
-        # measured tools/BENCH_SUITE.md 15:50 + tools/AB_RESULTS.md
-        # 16:41 blocks): auto -> single-bf16-product for WAVE growth —
-        # 2.12 vs 1.30 it/s at the 10.5M flagship (1.63x, gate 1.4x)
-        # with 13-iter AUC 0.89305 vs hi/lo 0.89295 (1.0e-4, gate 1e-3)
-        # and 1M AUC 0.9362 vs 0.9357 (5e-4, gate 1e-3).  The reference
-        # ships the same trade as ITS default (gpu_use_dp=false,
-        # docs/GPU-Performance.md).  Exact growth keeps hi/lo — it is
-        # the parity anchor (+7.7e-6 at 10.5M) and its engines never
-        # ran the bf16 kernels.
         if hp == "auto":
-            from .wave import pallas_wave_active as _pwa3
-            # scoped to serial EXECUTION (psum_axis is None) like the
-            # pallas_ct promotion above: every bf16 gate was measured
-            # on single-chip serial arms, so the true DP learner keeps
-            # hi/lo until a DP A/B lands
-            self.hist_hilo = not (growth == "wave"
-                                  and psum_axis is None
-                                  and _pwa3(self.hist_mode, self.dtype))
+            # the round-5 bf16 promotion PRIOR (ops/autotune.py
+            # prior_hist_hilo carries the measured provenance); scoped
+            # to serial wave execution like the pallas_ct promotion
+            from .autotune import prior_hist_hilo
+            self.hist_hilo = prior_hist_hilo(growth, psum_axis,
+                                             self.hist_mode, self.dtype)
         else:
             self.hist_hilo = hp != "bf16"
+        # resolved compaction flag — a plain config passthrough today,
+        # but an autotune-tunable dimension, so it lives on the learner
+        # (the wave jit below reads THIS, never the raw config)
+        self.wave_compact = bool(config.tpu_wave_compact)
         lk = str(config.tpu_wave_lookup).strip().lower()
         # validate unconditionally (like tpu_histogram_mode): a typo'd
         # value must not be silently ignored just because growth resolved
@@ -609,6 +485,51 @@ class SerialTreeLearner:
             if self.packed_cols:
                 binned = pack4_host(binned)
             self.X = jnp.asarray(binned)
+        if self._row_pad:
+            self._ones = jnp.concatenate(
+                [jnp.ones(train_data.num_data, self.dtype),
+                 jnp.zeros(self._row_pad, self.dtype)])
+        else:
+            self._ones = jnp.ones(train_data.num_data, self.dtype)
+        self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
+        # ---- measured kernel autotune (ops/autotune.py).  Everything
+        # resolved above — hist_mode, wave_width, hist_hilo,
+        # wave_compact — is the heuristic PRIOR; under
+        # tpu_autotune=measure/force on a real device, decide() probes
+        # the 3-5 candidate cells for this shape bucket on the uploaded
+        # bin matrix and the measured winner overrides the prior (the
+        # winner is cached on disk, so one probe cost per shape bucket
+        # per device kind).  Under off (the default) decide() only
+        # records the prior decision on the timeline.
+        from . import autotune as _at
+        at_shape = _at.ShapeBucket(int(ncols), int(_bin_pad(nbins)),
+                                   int(self.num_leaves),
+                                   _at.row_bucket(train_data.num_data))
+        at_prior = _at.Cell(self.hist_mode, int(self.wave_width),
+                            bool(self.hist_hilo), self.wave_compact)
+        at_pins = _at.Pins(
+            # pins = explicit user choices + quality gates, never tuned
+            kernel=str(config.tpu_histogram_mode) != "auto",
+            width=(int(config.tpu_wave_width) > 0
+                   or (_order_sensitive(config)
+                       and self.wave_order != "exact")),
+            precision=hp != "auto",
+            compact="tpu_wave_compact" in config.raw)
+        at_eligible = (growth == "wave" and psum_axis is None
+                       and not sparse_on and self.dtype == jnp.float32
+                       and self.hist_mode in WAVE_ONLY_MODES)
+        at_probe = (self._make_autotune_probe(config)
+                    if at_eligible else None)
+        dec = _at.decide(config, at_shape, at_prior, at_pins,
+                         at_eligible, probe=at_probe,
+                         ct_allowed=psum_axis is None)
+        self.autotune_mode, self.autotune_source = dec.mode, dec.source
+        self._pending_events.extend(dec.events)
+        if dec.cell != at_prior:
+            self.hist_mode = hist_mode = dec.cell.hist_mode
+            self.wave_width = int(dec.cell.wave_width)
+            self.hist_hilo = bool(dec.cell.hist_hilo)
+            self.wave_compact = bool(dec.cell.compact)
         # Ordered-partition growth (grow.py): per-split cost is O(parent
         # segment) for the partition and O(child segment * F) for the
         # histogram — the reference's DataPartition + ordered-iteration
@@ -632,7 +553,7 @@ class SerialTreeLearner:
                 int(config.tpu_wave_chunk), self.packed_cols,
                 self.sparse_col_cap, self.wave_order == "exact",
                 self.wave_lookup, self.hist_hilo,
-                bool(config.tpu_wave_compact))
+                self.wave_compact)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;
@@ -688,20 +609,61 @@ class SerialTreeLearner:
                                       group_bins=self.group_bins,
                                       row_capacities=self.row_capacities,
                                       cache_hists=self.cache_hists)
-        if self._row_pad:
-            self._ones = jnp.concatenate(
-                [jnp.ones(train_data.num_data, self.dtype),
-                 jnp.zeros(self._row_pad, self.dtype)])
-        else:
-            self._ones = jnp.ones(train_data.num_data, self.dtype)
-        self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
         # feature_fraction RNG persists across trees
         # (serial_tree_learner.cpp:40-96 Init + :257-275 BeforeTrain)
         self._feature_rng = Random(config.feature_fraction_seed)
 
+    # --------------------------------------------------------- autotuning
+    def _make_autotune_probe(self, config):
+        """Probe factory for ops/autotune.py: builds a candidate cell's
+        wave core STANDALONE — same statics as the production core
+        below except the cell's tuned dimensions — against the real
+        uploaded bin matrix with synthetic deterministic gradients, and
+        returns a nullary run closure the tuner times.  make_wave_jit
+        is lru-cached, so the winning cell's probe compile is reused by
+        the production core."""
+        from .wave import make_wave_jit, transposed_wave_active
+
+        def probe(cell):
+            core = make_wave_jit(
+                self.num_leaves, self.num_bins, self.params,
+                config.max_depth, int(cell.wave_width), self.dtype,
+                None, self.bundle_arrays is not None, self.group_bins,
+                self.cache_hists, cell.hist_mode,
+                int(config.tpu_wave_chunk), self.packed_cols,
+                self.sparse_col_cap, self.wave_order == "exact",
+                self.wave_lookup, bool(cell.hist_hilo),
+                bool(cell.compact))
+            xt = (jnp.transpose(self.X)
+                  if transposed_wave_active(cell.hist_mode, self.dtype)
+                  else None)
+            n = int(self._ones.shape[0])
+            # deterministic, real-shaped probe inputs: a sign-varying
+            # gradient so splits have gain and the wave actually sweeps
+            g = jnp.asarray(np.linspace(-1.0, 1.0, n), self.dtype)
+            h = jnp.full((n,), 0.25, self.dtype)
+            rm, mask = self._ones, self._full_mask
+            meta, bund = self.meta, self.bundle_arrays
+
+            def run():
+                tree, leaf_id = core(self.X, g, h, rm, mask, meta,
+                                     bund, Xt=xt)
+                jax.block_until_ready(leaf_id)
+
+            return run
+
+        return probe
+
     # -------------------------------------------------------- observability
     def set_observer(self, obs) -> None:
         self._obs = obs
+        pend = getattr(self, "_pending_events", None)
+        if pend and getattr(obs, "enabled", False):
+            # construction-time events (band escapes, autotune
+            # probes/decision) recorded now that the run header exists
+            for ev, fields in pend:
+                obs.event(ev, **fields)
+            del pend[:]
 
     def obs_info(self) -> dict:
         """Static run-header context: which engines/knobs this learner
@@ -714,6 +676,9 @@ class SerialTreeLearner:
             "wave_order": getattr(self, "wave_order", ""),
             "wave_lookup": getattr(self, "wave_lookup", ""),
             "hist_hilo": bool(getattr(self, "hist_hilo", True)),
+            "wave_compact": bool(getattr(self, "wave_compact", False)),
+            "autotune_mode": getattr(self, "autotune_mode", "off"),
+            "autotune_source": getattr(self, "autotune_source", ""),
             "packed_cols": int(getattr(self, "packed_cols", 0) or 0),
             "num_leaves": int(self.num_leaves),
             "num_bins": int(self.num_bins),

@@ -50,15 +50,22 @@ try:
 except ImportError:                                    # pragma: no cover
     HAS_PALLAS = False
 
-def _tile_shape(num_bins: int):
+def tile_shape(num_bins: int):
     """(F_BLK, ROW_CHUNK) sized so the (F_BLK*B, C) one-hot tile stays well
     under the ~16MB VMEM budget.  F_BLK stays at 8 (the TPU sublane
-    minimum for f32 blocks); large-B kernels shrink the row chunk."""
+    minimum for f32 blocks); large-B kernels shrink the row chunk.
+
+    Public: the kernel's VMEM geometry is part of the selection surface
+    the autotuner (ops/autotune.py) and its probe harness reason about
+    when instantiating kernel cells standalone."""
     f_blk = 8
     row_chunk = 2048
     while f_blk * num_bins * row_chunk * 4 > 6 * 2**20 and row_chunk > 512:
         row_chunk //= 2
     return f_blk, row_chunk
+
+
+_tile_shape = tile_shape        # pre-v8 private name, kept importable
 
 
 def _hist_kernel(x_ref, w_ref, out_ref, *, num_bins: int, f_blk: int):
@@ -99,7 +106,7 @@ def _hist_kernel(x_ref, w_ref, out_ref, *, num_bins: int, f_blk: int):
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
 def _hist_pallas(xt, w, num_bins: int, interpret: bool):
     f, n = xt.shape
-    f_blk, row_chunk = _tile_shape(num_bins)
+    f_blk, row_chunk = tile_shape(num_bins)
     grid = (f // f_blk, n // row_chunk)
     kernel = functools.partial(_hist_kernel, num_bins=num_bins, f_blk=f_blk)
     return pl.pallas_call(
@@ -132,7 +139,7 @@ def leaf_histogram_pallas(binned, grad, hess, leaf_id, leaf, row_mult,
                  None if row_mult is None
                  else jnp.asarray(row_mult, jnp.float32))   # (N, 3)
 
-    f_blk, row_chunk = _tile_shape(num_bins)
+    f_blk, row_chunk = tile_shape(num_bins)
     npad = (-n) % row_chunk
     fpad = (-f) % f_blk
     xt = binned.astype(jnp.float32).T                   # (F, N); bins < 2^24

@@ -64,6 +64,14 @@ def _bin_pad(num_bins: int) -> int:
     return ((num_bins + 127) // 128) * 128
 
 
+def hist_block_bytes(ncols: int, bin_pad: int, width: int) -> int:
+    """Bytes of the (ncols*bin_pad, 3W) f32 accumulator block the wave
+    kernels keep resident in VMEM — the single geometry fact behind the
+    auto-mode VMEM gate, the pathology band, and the autotuner's cell
+    enumeration (ops/autotune.py)."""
+    return ncols * bin_pad * 12 * width
+
+
 def _slot_hist(ohf, match, wc, W, hist_dtype, exact_order):
     """One wave chunk's histogram contraction: (C, q) one-hot x per-child
     masked weights -> (q, 3W).  Under exact order the contraction runs

@@ -128,6 +128,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "serve_slo_p99": "serve_slo_p99_ms",
     "serve_slo_window": "serve_slo_window_s",
     "serve_slo_snapshot_every": "serve_slo_every_s",
+    "autotune": "tpu_autotune",
+    "autotune_mode": "tpu_autotune",
+    "autotune_cache": "tpu_autotune_cache",
+    "autotune_cache_path": "tpu_autotune_cache",
+    "autotune_waves": "tpu_autotune_waves",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -170,6 +175,8 @@ PARAMETER_SET = {
     "tpu_sparse", "tpu_wave_order", "tpu_predict", "tpu_wave_lookup",
     "tpu_sparse_kernel", "tpu_hist_precision", "tpu_score_update",
     "tpu_wave_compact",
+    # measured kernel autotuner (ops/autotune.py)
+    "tpu_autotune", "tpu_autotune_cache", "tpu_autotune_waves",
     # observability (lightgbm_tpu/obs/)
     "obs_events_path", "obs_timing", "obs_memory_every",
     "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
@@ -477,6 +484,21 @@ class Config:
         # reassociation) — pinned vs the full-N pass in
         # tests/test_wave_compact.py.  Off until the on-chip A/B lands.
         "tpu_wave_compact": ("bool", False),
+        # 'off' | 'prior' | 'measure' | 'force' — the measured kernel
+        # autotuner (ops/autotune.py, docs/Autotuning.md).  off = the
+        # heuristic prior only (bit-identical to the legacy inline
+        # selection; the CPU-CI default).  prior = adopt a cached
+        # winner when one exists, never probe.  measure = on cache miss
+        # microbench the 3-5 candidate (kernel, W, precision,
+        # compaction) cells for the shape bucket on-device and persist
+        # the winner.  force = always re-probe, overwriting the cache.
+        "tpu_autotune": ("str", "off"),
+        # autotune cache file; empty = autotune_cache.json next to the
+        # XLA compile cache (LGBM_TPU_COMPILE_CACHE, utils/common.py)
+        "tpu_autotune_cache": ("str", ""),
+        # timed waves per probed cell (compile + one warmup wave are
+        # always excluded from the timing window)
+        "tpu_autotune_waves": ("int", 3),
         # observability (lightgbm_tpu/obs/): setting any of
         # obs_events_path / obs_trace_iters / obs_memory_every turns the
         # run observer on; all-defaults leaves the NULL observer in place

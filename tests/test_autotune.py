@@ -1,0 +1,422 @@
+"""Measured kernel autotuner (ops/autotune.py).
+
+* off-mode parity: with tpu_autotune=off (the CPU-CI default) the
+  selected cells are bit-identical to the legacy hand-tuned heuristics
+  across the benchmark shape buckets — the tuner must be a pure
+  superset of today's behaviour.
+* measured selection is deterministic under the injectable bench/timer
+  hooks (the SloEngine fake-clock pattern), round-trips through the
+  on-disk cache (a warm cache performs ZERO probe waves), and a cache
+  schema-rev bump invalidates every old entry.
+* off-TPU measure mode is a documented no-op falling back to the prior.
+* the band-escape width adjustment clamps to the first width strictly
+  past the band and reverts to the original width when no doubling can
+  clear it (wide-band regression), and the serial learner now leaves an
+  audit trail (wave_band_escape event) when it fires.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import autotune
+from lightgbm_tpu.ops.autotune import (Cell, Pins, ShapeBucket,
+                                       band_adjusted_width, decide,
+                                       enumerate_cells, measure_cells,
+                                       prior_hist_mode, resolve_wave_order,
+                                       resolve_wave_width, row_bucket)
+from lightgbm_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    autotune.clear_probe_hooks()
+    yield
+    autotune.clear_probe_hooks()
+
+
+def _cfg(num_leaves, **kw):
+    kw.setdefault("verbose", -1)
+    kw["num_leaves"] = num_leaves
+    return Config(kw)
+
+
+# --------------------------------------------------------------- off parity
+
+# the benchmark shape buckets (tools/BENCH_SUITE.md) and the cells the
+# legacy inline heuristics picked for them on TPU; tpu_autotune=off must
+# reproduce these exactly (ncols, bin_pad, num_leaves, mode, width)
+LEGACY_TABLE = [
+    ("flagship", 28, 256, 255, "pallas_t", 32),   # narrow-F, no band
+    ("epsilon", 2000, 64, 63, "pallas_t", 32),    # W16 24.6MB band -> 32
+    ("msltr", 136, 256, 255, "pallas_t", 32),     # 13.4MB, under band
+    ("expo_cat", 40, 64, 31, "pallas_ct", 8),     # 40*64=2560: ct bound
+    ("bosch", 968, 64, 255, "pallas_t", 64),      # W32 23.8MB band -> 64
+    ("bosch_widepad", 968, 256, 255, "onehot", None),  # 95MB > VMEM gate
+]
+
+
+@pytest.mark.parametrize("name,ncols,bin_pad,leaves,mode,width",
+                         LEGACY_TABLE)
+def test_off_mode_matches_legacy_heuristics(name, ncols, bin_pad, leaves,
+                                            mode, width):
+    cfg = _cfg(leaves)
+    got_mode = prior_hist_mode(cfg, ncols, bin_pad, leaves, None,
+                               on_tpu=True)
+    assert got_mode == mode, name
+    if width is not None:
+        w = band_adjusted_width(
+            resolve_wave_width(cfg, leaves, resolve_wave_order(cfg)),
+            ncols, bin_pad)
+        assert w == width, name
+
+
+def test_off_mode_decide_is_identity():
+    """tpu_autotune=off returns the prior cell untouched — no cache
+    read, no probes — while still recording the decision."""
+    prior = Cell("pallas_t", 32, True, False)
+    d = decide(_cfg(255), ShapeBucket(28, 256, 255, 1 << 20), prior,
+               Pins(), eligible=True)
+    assert d.cell == prior and d.source == "off" and not d.probes
+    evs = [ev for ev, _ in d.events]
+    assert evs == ["autotune_decision"]
+
+
+def test_ineligible_decide_keeps_prior(tmp_path):
+    cfg = _cfg(31, tpu_autotune="measure",
+               tpu_autotune_cache=str(tmp_path / "c.json"))
+    prior = Cell("onehot", 1, True, False)
+    d = decide(cfg, ShapeBucket(28, 256, 31, 4096), prior, Pins(),
+               eligible=False)
+    assert d.cell == prior and d.source == "ineligible" and not d.probes
+
+
+# ----------------------------------------------------------- measured path
+
+def _bench(cell, bucket):
+    """Deterministic synthetic cost: wider faster, bf16 beats hilo, ct
+    pays a tax, compaction a small win."""
+    s = 1.0 / max(1, cell.wave_width)
+    if cell.hist_hilo:
+        s += 0.1
+    if cell.hist_mode == "pallas_ct":
+        s += 0.5
+    if cell.compact:
+        s -= 0.01
+    return s
+
+
+def test_measure_mode_deterministic_winner(tmp_path):
+    autotune.install_probe_hooks(bench=_bench)
+    cfg = _cfg(15, tpu_autotune="measure",
+               tpu_autotune_cache=str(tmp_path / "c.json"))
+    prior = Cell("pallas_t", 8, True, False)
+    d = decide(cfg, ShapeBucket(8, 64, 15, 2048), prior, Pins(),
+               eligible=True)
+    assert d.source == "measured" and not d.cache_hit
+    # bf16 at the prior width wins under the synthetic costs
+    assert d.cell == Cell("pallas_t", 8, False, False)
+    assert len(d.probes) == 5 and d.margin > 0 and d.overhead_s > 0
+    probe_evs = [f for ev, f in d.events if ev == "autotune_probe"]
+    assert len(probe_evs) == 5
+    assert all(f["s_per_wave"] == _bench(Cell.from_dict(f["cell"]), None)
+               for f in probe_evs)
+
+
+def test_cache_round_trip_skips_probing(tmp_path):
+    autotune.install_probe_hooks(bench=_bench)
+    cache = str(tmp_path / "c.json")
+    cfg = _cfg(15, tpu_autotune="measure", tpu_autotune_cache=cache)
+    prior = Cell("pallas_t", 8, True, False)
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    d1 = decide(cfg, bucket, prior, Pins(), eligible=True)
+    assert d1.source == "measured"
+    with open(cache) as f:
+        blob = json.load(f)
+    assert blob["version"] == autotune.CACHE_SCHEMA_REV
+    assert autotune.cache_key(autotune._device_kind(), bucket) \
+        in blob["entries"]
+    # warm cache: zero probe waves, same winner
+    d2 = decide(cfg, bucket, prior, Pins(), eligible=True)
+    assert d2.source == "cache" and d2.cache_hit and not d2.probes
+    assert d2.cell == d1.cell
+    assert [ev for ev, _ in d2.events] == ["autotune_decision"]
+    # a different bucket is a different key -> probes again
+    d3 = decide(cfg, ShapeBucket(8, 64, 15, 4096), prior, Pins(),
+                eligible=True)
+    assert d3.source == "measured"
+
+
+def test_cache_invalidated_by_schema_rev_bump(tmp_path, monkeypatch):
+    autotune.install_probe_hooks(bench=_bench)
+    cfg = _cfg(15, tpu_autotune="measure",
+               tpu_autotune_cache=str(tmp_path / "c.json"))
+    prior = Cell("pallas_t", 8, True, False)
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    assert decide(cfg, bucket, prior, Pins(),
+                  eligible=True).source == "measured"
+    assert decide(cfg, bucket, prior, Pins(),
+                  eligible=True).source == "cache"
+    monkeypatch.setattr(autotune, "CACHE_SCHEMA_REV",
+                        autotune.CACHE_SCHEMA_REV + 1)
+    d = decide(cfg, bucket, prior, Pins(), eligible=True)
+    assert d.source == "measured" and not d.cache_hit
+
+
+def test_cached_winner_respects_pins(tmp_path):
+    """A cache entry tuned without pins must not override a pinned
+    dimension on reuse."""
+    autotune.install_probe_hooks(bench=_bench)
+    cfg = _cfg(15, tpu_autotune="measure",
+               tpu_autotune_cache=str(tmp_path / "c.json"))
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    d1 = decide(cfg, bucket, Cell("pallas_t", 8, True, False), Pins(),
+                eligible=True)
+    assert d1.cell.wave_width == 8  # cached winner: W=8 bf16
+    # now the same bucket with width pinned at 4: the cached cell's
+    # width must be replaced by the prior's
+    prior = Cell("pallas_t", 4, True, False)
+    d2 = decide(cfg, bucket, prior, Pins(width=True), eligible=True)
+    assert d2.source == "cache" and d2.cell.wave_width == 4
+
+
+def test_corrupt_cache_is_empty_cache(tmp_path):
+    autotune.install_probe_hooks(bench=_bench)
+    cache = tmp_path / "c.json"
+    cache.write_text("{not json")
+    cfg = _cfg(15, tpu_autotune="measure", tpu_autotune_cache=str(cache))
+    d = decide(cfg, ShapeBucket(8, 64, 15, 2048),
+               Cell("pallas_t", 8, True, False), Pins(), eligible=True)
+    assert d.source == "measured"   # re-probed, did not raise
+
+
+def test_force_mode_ignores_cache(tmp_path):
+    autotune.install_probe_hooks(bench=_bench)
+    cache = str(tmp_path / "c.json")
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    prior = Cell("pallas_t", 8, True, False)
+    decide(_cfg(15, tpu_autotune="measure", tpu_autotune_cache=cache),
+           bucket, prior, Pins(), eligible=True)
+    d = decide(_cfg(15, tpu_autotune="force", tpu_autotune_cache=cache),
+               bucket, prior, Pins(), eligible=True)
+    assert d.source == "measured" and d.probes
+
+
+def test_measure_off_tpu_is_noop(tmp_path):
+    """No TPU, no injected hooks: measure mode falls back to the prior
+    with zero probes (CPU CI must not pay wave compiles)."""
+    cfg = _cfg(15, tpu_autotune="measure",
+               tpu_autotune_cache=str(tmp_path / "c.json"))
+    prior = Cell("pallas_t", 8, True, False)
+    d = decide(cfg, ShapeBucket(8, 64, 15, 2048), prior, Pins(),
+               eligible=True, probe=lambda cell: (lambda: None))
+    assert d.cell == prior and d.source == "prior" and not d.probes
+    assert not os.path.exists(str(tmp_path / "c.json"))
+
+
+def test_measure_cells_injectable_timer():
+    """With a fake clock the measured s/wave is exact: the timer ticks
+    once before and once after the timed loop."""
+    ticks = [0.0]
+
+    def timer():
+        ticks[0] += 1.0
+        return ticks[0]
+
+    autotune.install_probe_hooks(timer=timer)
+    cells = [Cell("pallas_t", 8, True, False),
+             Cell("pallas_t", 16, True, False)]
+    events = []
+    out = measure_cells(cells, ShapeBucket(8, 64, 15, 2048),
+                        lambda cell: (lambda: None), waves=4,
+                        events=events)
+    assert [(c, s) for c, s in out] == [(cells[0], 0.25),
+                                        (cells[1], 0.25)]
+    assert len(events) == 2 and all(e[0] == "autotune_probe"
+                                    for e in events)
+
+
+def test_failed_probe_drops_candidate_not_training():
+    autotune.install_probe_hooks(force=True)
+
+    def probe(cell):
+        if cell.wave_width == 16:
+            raise RuntimeError("mosaic says no")
+        return lambda: None
+
+    events = []
+    out = measure_cells([Cell("pallas_t", 8, True, False),
+                         Cell("pallas_t", 16, True, False)],
+                        ShapeBucket(8, 64, 15, 2048), probe, 1, events)
+    assert [c.wave_width for c, _ in out] == [8]
+
+
+# ------------------------------------------------------------- enumeration
+
+def test_enumerate_cells_respects_pins_and_gates():
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    prior = Cell("pallas_t", 8, True, False)
+    cells = enumerate_cells(prior, bucket, Pins())
+    assert cells[0] == prior and len(cells) <= autotune.MAX_CELLS
+    widths = {c.wave_width for c in cells}
+    assert {4, 8, 16} <= widths
+    # fully pinned: only the prior survives
+    assert enumerate_cells(prior, bucket,
+                           Pins(True, True, True, True)) == [prior]
+    # non-wave kernels have no neighbours
+    assert enumerate_cells(Cell("onehot", 1, True, False), bucket,
+                           Pins()) == [Cell("onehot", 1, True, False)]
+    # VMEM hard gate: a W*2 neighbour whose block exceeds the budget is
+    # not enumerated (bosch-wide: W64 at 968x256 would be 190 MB)
+    wide = ShapeBucket(968, 256, 255, 1 << 20)
+    big = enumerate_cells(Cell("pallas_t", 32, True, False), wide, Pins())
+    assert all(c.wave_width <= 32 for c in big)
+    # ct cells are only candidates where ct may run (serial execution)
+    no_ct = enumerate_cells(prior, bucket, Pins(), ct_allowed=False)
+    assert all(c.hist_mode == "pallas_t" for c in no_ct)
+
+
+def test_ct_beyond_promotion_bound_is_a_candidate():
+    """The 2560 ct bound is a PRIOR, not a hard gate: measure mode
+    probes the ct arm on shapes the heuristic would never promote."""
+    bucket = ShapeBucket(136, 256, 255, 1 << 20)   # 34816 >> 2560
+    cells = enumerate_cells(Cell("pallas_t", 32, True, False), bucket,
+                            Pins())
+    assert any(c.hist_mode == "pallas_ct" for c in cells)
+
+
+def test_row_bucket_powers_of_two():
+    assert row_bucket(1) == 1
+    assert row_bucket(1000) == 1024
+    assert row_bucket(1024) == 1024
+    assert row_bucket(1025) == 2048
+
+
+# ------------------------------------------------------------- band clamp
+
+def test_band_clamp_stops_at_first_width_past_band():
+    """The escape lands on the FIRST width strictly past the upper
+    edge — it must not keep doubling once clear (regression for the
+    upper-edge clamp)."""
+    # epsilon W16: 24.6 MB in band; W32 = 49.1 MB clears -> stop at 32,
+    # even though W64 (98 MB) would be "even further past"
+    assert band_adjusted_width(16, 2000, 64) == 32
+    # bosch W32: 23.8 MB in band; W64 = 47.6 MB clears -> 64 exactly
+    assert band_adjusted_width(32, 968, 64) == 64
+
+
+def test_band_clamp_reverts_when_escape_cannot_clear(monkeypatch):
+    """If no doubling inside the W cap / VMEM gate lands past the band,
+    the ORIGINAL width is kept — an escape stopping at an unmeasured
+    in-band cell would trade a measured pathology for an unmeasured
+    one.  Probed with an artificially wide band."""
+    monkeypatch.setattr(autotune, "HIST_BLOCK_BAND",
+                        (18 << 20, 70 << 20))
+    # bosch W32 = 23.8 MB; doubling stops at the W=64 cap with 47.6 MB
+    # still inside the widened band -> revert to 32 (the old code would
+    # have returned the in-band 64)
+    assert band_adjusted_width(32, 968, 64) == 32
+    # epsilon W16 = 24.6 MB; W32 = 49.1 MB still in band, W64 = 98 MB
+    # would clear but violates the 64 MB VMEM gate -> revert to 16
+    assert band_adjusted_width(16, 2000, 64) == 16
+    # 1200 cols W32 = 29.5 MB -> W64 = 59 MB, still inside the widened
+    # band and the next doubling hits the W cap -> revert too
+    assert band_adjusted_width(32, 1200, 64) == 32
+    monkeypatch.setattr(autotune, "HIST_BLOCK_BAND",
+                        (18 << 20, 40 << 20))
+    # with a 40 MB upper edge W64 (47.6 MB) clears again
+    assert band_adjusted_width(32, 968, 64) == 64
+
+
+def test_band_escape_emits_audit_event(monkeypatch):
+    """When the serial learner's auto width escapes the band (faked TPU
+    backend, same shape as tests/test_wave.py), the escape leaves a
+    wave_band_escape event queued for the observer — it used to happen
+    silently — alongside the always-present autotune_decision."""
+    import jax
+
+    from lightgbm_tpu.io.dataset import TrainingData
+    from lightgbm_tpu.ops.learner import SerialTreeLearner
+    from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
+
+    rng = np.random.default_rng(23)
+    Xw = rng.normal(size=(600, 1200))
+    yw = (Xw[:, 0] > 0).astype(np.float64)
+    cfg = Config({"num_leaves": 255, "verbose": -1, "max_bin": 63,
+                  "enable_bundle": False})
+    td = TrainingData.from_matrix(Xw, label=yw, config=cfg)
+    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    try:
+        lrn = SerialTreeLearner(cfg, td)
+        assert lrn.wave_width == 64
+        esc = [f for ev, f in lrn._pending_events
+               if ev == "wave_band_escape"]
+        assert len(esc) == 1
+        assert esc[0]["width_from"] == 32 and esc[0]["width_to"] == 64
+        assert esc[0]["ncols"] == 1200 and esc[0]["bin_pad"] == 64
+        assert (esc[0]["band_lo_mb"] <= esc[0]["block_mb"]
+                < esc[0]["band_hi_mb"])
+        decs = [f for ev, f in lrn._pending_events
+                if ev == "autotune_decision"]
+        assert len(decs) == 1 and decs[0]["mode"] == "off"
+        assert decs[0]["cell"]["wave_width"] == 64
+    finally:
+        monkeypatch.undo()
+        make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+
+
+# ------------------------------------------------------------ integration
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_train_measure_then_cache_hit(tmp_path):
+    """End-to-end through lgb.train on CPU via the bench hook: first
+    run probes and persists, second run is a cache hit with zero probe
+    waves — the decision/probe events land on the timeline through the
+    learner's pending-events queue."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((800, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    autotune.install_probe_hooks(bench=_bench)
+
+    def run(tag):
+        ev_path = str(tmp_path / ("%s.jsonl" % tag))
+        p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+             "min_data_in_leaf": 5, "tpu_growth": "wave",
+             "tpu_histogram_mode": "pallas_t", "tpu_autotune": "measure",
+             "tpu_autotune_cache": str(tmp_path / "cache.json"),
+             "obs_events_path": ev_path}
+        lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                  num_boost_round=2)
+        evs = _events(ev_path)
+        return ([e for e in evs if e.get("ev") == "autotune_decision"],
+                [e for e in evs if e.get("ev") == "autotune_probe"])
+
+    d1, p1 = run("run1")
+    assert len(d1) == 1 and d1[0]["source"] == "measured" and p1
+    d2, p2 = run("run2")
+    assert len(d2) == 1 and d2[0]["source"] == "cache" and not p2
+    assert d2[0]["cache_hit"] and d2[0]["cell"] == d1[0]["cell"]
+
+
+def test_train_off_mode_single_decision(tmp_path):
+    """Default params: exactly one decision event, mode off, zero
+    probes — the bench.py --dry contract."""
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((500, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ev_path = str(tmp_path / "off.jsonl")
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5, "obs_events_path": ev_path}
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)
+    evs = _events(ev_path)
+    decs = [e for e in evs if e.get("ev") == "autotune_decision"]
+    assert len(decs) == 1
+    assert decs[0]["mode"] == "off" and decs[0]["source"] == "off"
+    assert not [e for e in evs if e.get("ev") == "autotune_probe"]

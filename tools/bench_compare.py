@@ -28,7 +28,7 @@ Usage:
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
         [--tol-recompile 0] [--tol-eval 0.02] \
         [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] \
-        [--tol-serve-shed 0.25] [--json]
+        [--tol-serve-shed 0.25] [--tol-autotune 0.50] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -71,6 +71,13 @@ METRICS = {
     # sheds nothing, so ANY shedding in the candidate is a regression;
     # overload-vs-overload runs tolerate 25% load-generator noise
     "serve_shed_rate": (-1, 0.25),
+    # total probe seconds the kernel autotuner paid this run (summed
+    # over autotune_decision events, ops/autotune.py).  Zero on cache
+    # hits / tuning off — the zero-baseline rule makes ANY candidate
+    # probing vs a warm-cache baseline a regression, which is exactly
+    # the "second run on the same shape performs zero probe waves"
+    # contract; measure-vs-measure runs tolerate 50% timer noise
+    "autotune_overhead_s": (-1, 0.50),
 }
 
 
@@ -129,6 +136,12 @@ def _from_timeline(events):
         out["serve_p99_s"] = float(serve[-1]["p99_s"])
         if serve[-1].get("shed_rate") is not None:
             out["serve_shed_rate"] = float(serve[-1]["shed_rate"])
+    # kernel-autotuner probe cost (schema v8): present whenever the run
+    # recorded a decision, zero when the cache was warm or tuning off
+    decs = [e for e in events if e.get("ev") == "autotune_decision"]
+    if decs:
+        out["autotune_overhead_s"] = sum(
+            float(e.get("overhead_s", 0.0)) for e in decs)
     return out
 
 
@@ -248,6 +261,10 @@ def main(argv=None):
         "serve_shed_rate"][1],
         help="serving shed-rate relative tolerance (a zero-shed "
              "baseline fails on ANY candidate shedding)")
+    ap.add_argument("--tol-autotune", type=float, default=METRICS[
+        "autotune_overhead_s"][1],
+        help="autotune probe-overhead relative tolerance (a warm-cache "
+             "zero-overhead baseline fails on ANY candidate probing)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
@@ -257,7 +274,8 @@ def main(argv=None):
             "final_eval_metric": args.tol_eval,
             "serve_qps": args.tol_serve_qps,
             "serve_p99_s": args.tol_serve_p99,
-            "serve_shed_rate": args.tol_serve_shed}
+            "serve_shed_rate": args.tol_serve_shed,
+            "autotune_overhead_s": args.tol_autotune}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

@@ -105,6 +105,18 @@ NOTES = {
                         "ulps at multi-tile N); opt-in",
     "tpu_bin_pack": "auto / true / false — 4-bit bin packing (at most 16 "
                     "bins/column: max_bin<=15 plus the reserved bin)",
+    "tpu_autotune": "off / prior / measure / force — measured on-device "
+                    "kernel autotuner for the wave cell (hist kernel, "
+                    "wave width, precision, compaction): off = hand-tuned "
+                    "heuristics only, prior = heuristics + decision "
+                    "telemetry, measure = microbench the viable cells on "
+                    "a cache miss, force = always re-measure; see "
+                    "Autotuning.md",
+    "tpu_autotune_cache": "autotune decision cache path (JSON); empty = "
+                          "autotune_cache.json next to the XLA compile "
+                          "cache",
+    "tpu_autotune_waves": "timed waves per probed cell in measure/force "
+                          "mode (plus one untimed warmup wave)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
                   "engine, serial + data-parallel; histograms from "
                   "nonzeros only)",
@@ -247,6 +259,8 @@ GROUPS = [
         "tpu_hist_precision", "tpu_score_update", "tpu_bin_pack",
         "tpu_sparse", "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
         "tpu_profile_dir"]),
+    ("Autotune", [
+        "tpu_autotune", "tpu_autotune_cache", "tpu_autotune_waves"]),
     ("Observability", [
         "obs_events_path", "obs_timing", "obs_memory_every",
         "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
