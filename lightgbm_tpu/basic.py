@@ -182,7 +182,10 @@ class Dataset:
         feature_names = None
         if isinstance(self.data, str):
             ref_td = self.reference._handle if self.reference is not None else None
-            if TrainingData.can_load_binary(self.data):
+            if TrainingData.can_load_binned(self.data):
+                # pre-binned mmap directory: zero re-binning work
+                self._handle = TrainingData.from_binned(self.data)
+            elif TrainingData.can_load_binary(self.data):
                 self._handle = TrainingData.load_binary(self.data)
             else:
                 self._handle = TrainingData.from_file(self.data, cfg,
@@ -417,6 +420,23 @@ class Dataset:
         """Save the constructed (binned) dataset for fast reload."""
         self.construct()
         self._handle.save_binary(filename)
+
+    def save_binned(self, path: str) -> "Dataset":
+        """Persist as the mmap-able pre-binned directory format: later
+        runs open it with Dataset.from_binned (or just Dataset(path)) and
+        skip host-side binning entirely."""
+        self.construct()
+        self._handle.save_binned(path)
+        return self
+
+    @classmethod
+    def from_binned(cls, path: str, params=None) -> "Dataset":
+        """Open a pre-binned dataset directory written by save_binned()
+        or the streaming `ooc_binned_dir` ingest; shards stay mmap-backed
+        and page to the device without a host-side bin matrix."""
+        ds = cls(path, params=params)
+        ds._handle = TrainingData.from_binned(path)
+        return ds
 
 
 class _InnerPredictor:

@@ -56,6 +56,9 @@ def run_train(cfg: Config) -> None:
     # keep raw rows when continuing: loaded models predict on raw values
     train_td = TrainingData.from_file(cfg.data, cfg,
                                       keep_raw=bool(cfg.input_model))
+    if getattr(train_td, "_binned_reader", None) is not None:
+        Log.info("Train data is pre-binned (mmap-backed, %d shard(s), "
+                 "zero re-binning)", train_td._binned_reader.num_shards)
     objective = create_objective(cfg.objective, cfg)
     if objective is not None:
         objective.init(train_td.metadata, train_td.num_data)

@@ -40,6 +40,12 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     init_iteration = (len(predictor.gbdt.models) // max(predictor.gbdt.num_tree_per_iteration, 1)
                       if predictor is not None else 0)
 
+    if isinstance(train_set, str):
+        # pre-binned dataset directory (io/binned_format.py): open it
+        # transparently — construction cost was paid at save_binned time
+        from .io.dataset import TrainingData
+        if TrainingData.can_load_binned(train_set):
+            train_set = Dataset.from_binned(train_set)
     if not isinstance(train_set, Dataset):
         raise TypeError("Training only accepts Dataset object")
     train_set._update_params(params)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import time as _time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -47,7 +48,12 @@ class TrainingData:
         self.used_feature_idx: List[int] = []
         # real -> inner (-1 if unused), used_feature_map_ in the reference
         self.real_to_inner: Dict[int, int] = {}
-        self.binned: Optional[np.ndarray] = None      # (N, F_used)
+        # mmap-backed shard reader (io/binned_format.py) when the dataset
+        # came from / was streamed to the pre-binned on-disk format; the
+        # `binned` property materializes from it only on demand so paged
+        # device uploads never build the full host matrix
+        self._binned_reader = None
+        self._binned: Optional[np.ndarray] = None     # (N, F_used)
         self.metadata: Metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
@@ -61,7 +67,40 @@ class TrainingData:
         # data-quality profile of the binning sample (obs/dataquality.py);
         # None when binning was copied/loaded rather than fitted here
         self._data_profile: Optional[dict] = None
+        # construction-phase accounting for the `dataset_construct` obs
+        # event (rows, chunks, phase seconds, peak RSS, workers)
+        self._construct_stats: Optional[dict] = None
         self._comm = None
+
+    @property
+    def binned(self) -> Optional[np.ndarray]:
+        if self._binned is None and self._binned_reader is not None:
+            self._binned = self._binned_reader.matrix()
+        return self._binned
+
+    @binned.setter
+    def binned(self, value) -> None:
+        self._binned = value
+
+    def _note_construct_stats(self, source: str, rows: int, chunks: int,
+                              sketch_s: float, bin_s: float, write_s: float,
+                              workers: int, rss_before: int,
+                              **extra) -> None:
+        from .streaming import _peak_rss_bytes
+        peak = _peak_rss_bytes()
+        self._construct_stats = {
+            "source": source,
+            "rows": int(rows),
+            "chunks": int(chunks),
+            "sketch_s": round(float(sketch_s), 6),
+            "bin_s": round(float(bin_s), 6),
+            "write_s": round(float(write_s), 6),
+            "construct_s": round(float(sketch_s + bin_s + write_s), 6),
+            "peak_rss_bytes": int(peak),
+            "rss_growth_bytes": max(int(peak) - int(rss_before), 0),
+            "workers": int(workers),
+        }
+        self._construct_stats.update(extra)
 
     # ------------------------------------------------------------- construct
     @classmethod
@@ -89,6 +128,10 @@ class TrainingData:
         # remember the comm: the Booster shards its observer's timeline
         # per rank (obs/events.py) off the training data's comm
         self._comm = comm if (comm is not None and comm.size > 1) else None
+        from .streaming import _peak_rss_bytes
+        rss0 = _peak_rss_bytes()
+        t0 = _time.time()
+        sketch_s = 0.0
         if reference is not None:
             self._align_with(reference, data)
         elif comm is not None and comm.size > 1:
@@ -98,10 +141,16 @@ class TrainingData:
             from ..parallel.comm import sync_config_across_ranks
             sync_config_across_ranks(comm, config)
             self._construct_mappers_distributed(data, config, cats, comm)
+            sketch_s = _time.time() - t0
             self._bin_data(data)
         else:
             self._construct_mappers(data, config, cats)
+            sketch_s = _time.time() - t0
             self._bin_data(data)
+        self._note_construct_stats("matrix", rows=self.num_data, chunks=1,
+                                   sketch_s=sketch_s,
+                                   bin_s=_time.time() - t0 - sketch_s,
+                                   write_s=0.0, workers=1, rss_before=rss0)
         if keep_raw:
             self.raw_data = data
         if label is not None:
@@ -141,6 +190,9 @@ class TrainingData:
         self.feature_names = list(feature_names) if feature_names else [
             "Column_%d" % i for i in range(sp.num_col)]
         cats = set(int(c) for c in categorical_feature)
+        from .streaming import _peak_rss_bytes
+        rss0 = _peak_rss_bytes()
+        t0 = _time.time()
 
         if reference is not None:
             if sp.num_col != reference.num_total_features:
@@ -181,6 +233,9 @@ class TrainingData:
                 m.find_bin(fb[fb != 0.0], total_sample, config.max_bin,
                            config.min_data_in_bin, filter_cnt, bin_type)
                 self.bin_mappers.append(m)
+            # the row->sample map is O(N) int64 — drop it before the
+            # (N, G) binned product allocates (RSS watermark audit)
+            del sample_pos
 
             self.used_feature_idx = [
                 i for i, m in enumerate(self.bin_mappers)
@@ -232,7 +287,12 @@ class TrainingData:
                              self.bundle.num_groups)
             del col_sample_cache
 
+        sketch_s = _time.time() - t0
         self._bin_sparse(sp)
+        self._note_construct_stats("csc", rows=n, chunks=1,
+                                   sketch_s=sketch_s,
+                                   bin_s=_time.time() - t0 - sketch_s,
+                                   write_s=0.0, workers=1, rss_before=rss0)
         if label is not None:
             self.metadata.set_label(label)
         else:
@@ -278,6 +338,9 @@ class TrainingData:
         """CLI/file path (dataset_loader.cpp:159-216): parse, side files,
         label column handling."""
         config = config or Config()
+        if cls.can_load_binned(filename):
+            # pre-binned directory: construction cost was already paid
+            return cls.from_binned(filename)
         label_idx = 0
         header_names: Optional[List[str]] = None
         if config.has_header:
@@ -307,7 +370,10 @@ class TrainingData:
             file_bytes = os.path.getsize(filename)
         except OSError:
             pass
-        want_stream = (config.use_two_round_loading
+        out_dir = (str(config.ooc_binned_dir)
+                   if getattr(config, "ooc_binned_dir", "")
+                   and reference is None else None)
+        want_stream = (config.use_two_round_loading or bool(out_dir)
                        or file_bytes > (256 << 20)) and not keep_raw
         if want_stream and _streaming.stream_supported(filename,
                                                        config.has_header):
@@ -330,11 +396,17 @@ class TrainingData:
                 categorical = {keep.index(c) for c in categorical
                                if c in keep}
             _streaming.stream_load(self, filename, config, label_idx,
-                                   categorical, keep, reference=reference)
+                                   categorical, keep, reference=reference,
+                                   out_dir=out_dir)
             if not self.feature_names:
                 self.feature_names = ["Column_%d" % i
                                       for i in range(self.num_total_features)]
             self.metadata.init_from_file(filename)
+            if out_dir:
+                # side files (.weight/.query/.init) load after streaming,
+                # so refresh the persisted metadata sidecars
+                from . import binned_format as _bf
+                _bf.update_metadata(out_dir, self.metadata)
             return self
 
         parsed = _parser.parse_file(filename, has_header=config.has_header,
@@ -404,6 +476,9 @@ class TrainingData:
                 binned_sample, self.num_bin_arr, self.default_bin_arr,
                 config.max_conflict_rate, config.min_data_in_leaf,
                 self.num_data)
+            # drop the (S, F) sample bins before the (N, G) product
+            # allocates (retained-intermediate RSS audit, BENCH_NOTES.md)
+            del binned_sample
             if self.bundle is not None:
                 Log.info("EFB bundled %d features into %d groups",
                          len(self.used_feature_idx), self.bundle.num_groups)
@@ -478,6 +553,7 @@ class TrainingData:
                     binned_sample, self.num_bin_arr, self.default_bin_arr,
                     config.max_conflict_rate, config.min_data_in_leaf,
                     total_n)
+                del binned_sample
                 if layout is not None:
                     groups = [list(map(int, g)) for g in layout.groups]
             groups = comm.allgather_obj(groups)[0]
@@ -677,6 +753,105 @@ class TrainingData:
                 self.metadata.query_boundaries = z["query_boundaries"]
             if "init_score" in z:
                 self.metadata.init_score = z["init_score"]
+        return self
+
+    # --------------------------------------------- pre-binned mmap format
+    @classmethod
+    def from_streamed(cls, data, label=None, config: Optional[Config] = None,
+                      weights=None, group=None, init_score=None,
+                      categorical_feature: Sequence[int] = (),
+                      feature_names: Optional[List[str]] = None,
+                      reference: Optional["TrainingData"] = None,
+                      out_dir: Optional[str] = None,
+                      chunk_rows: Optional[int] = None) -> "TrainingData":
+        """Out-of-core construction from an in-memory matrix, a ``.npy``
+        path, or SparseColumns — the two-pass parallel pipeline of
+        io/streaming.py (text files go through from_file, which streams
+        automatically).  out_dir persists the result as a binned dataset
+        directory and keeps td mmap-backed."""
+        from . import streaming as _streaming
+        config = config or Config()
+        chunk = int(chunk_rows or config.ooc_chunk_rows
+                    or _streaming.DEFAULT_CHUNK_ROWS)
+        if hasattr(data, "colptr"):          # SparseColumns
+            source = _streaming.SparseSource(data, label=label,
+                                             chunk_rows=chunk)
+        else:
+            source = _streaming.MatrixSource(data, label=label,
+                                             chunk_rows=chunk)
+        self = cls()
+        self.feature_names = list(feature_names) if feature_names else []
+        cats = set(int(c) for c in categorical_feature)
+        _streaming.stream_construct(self, source, config, categorical=cats,
+                                    reference=reference, out_dir=out_dir)
+        if not self.feature_names:
+            self.feature_names = ["Column_%d" % i
+                                  for i in range(self.num_total_features)]
+        if weights is not None:
+            self.metadata.set_weights(weights)
+        if group is not None:
+            self.metadata.set_query_counts(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        if out_dir and (weights is not None or group is not None
+                        or init_score is not None):
+            from . import binned_format as _bf
+            _bf.update_metadata(out_dir, self.metadata)
+        return self
+
+    def save_binned(self, path: str) -> None:
+        """Persist as the mmap-able pre-binned directory format
+        (io/binned_format.py) so later runs skip construction entirely."""
+        from . import binned_format as _bf
+        _bf.save_training_data(self, path)
+
+    @classmethod
+    def can_load_binned(cls, path) -> bool:
+        from . import binned_format as _bf
+        return _bf.is_binned_dir(path)
+
+    @classmethod
+    def from_binned(cls, path: str, verify: bool = True) -> "TrainingData":
+        """Open a pre-binned dataset directory: shards stay mmap-backed
+        (no bin matrix materialized until something asks for it; the
+        learner pages shards straight to the device)."""
+        from . import binned_format as _bf
+        from .streaming import _peak_rss_bytes
+        rss0 = _peak_rss_bytes()
+        t0 = _time.time()
+        reader = _bf.BinnedReader(path, verify=verify)
+        h = reader.header
+        self = cls()
+        self.num_data = int(h["num_data"])
+        self.num_total_features = int(h["num_total_features"])
+        self.used_feature_idx = list(h["used_feature_idx"])
+        self.real_to_inner = {r: i for i, r in
+                              enumerate(self.used_feature_idx)}
+        self.feature_names = list(h["feature_names"])
+        self.max_bin = int(h["max_bin"])
+        self.bin_mappers = [None if d is None else BinMapper.from_dict(d)
+                            for d in h["bin_mappers"]]
+        self._build_feature_arrays()
+        groups = h.get("bundle_groups")
+        if groups is not None:
+            self.bundle = build_layout(groups, self.num_bin_arr,
+                                       self.default_bin_arr)
+        self._binned_reader = reader
+        self.metadata = Metadata(self.num_data)
+        label = reader.load_metadata_array("label")
+        if label is not None:
+            self.metadata.label = label
+        self.metadata.weights = reader.load_metadata_array("weights")
+        self.metadata.query_boundaries = \
+            reader.load_metadata_array("query_boundaries")
+        self.metadata.init_score = reader.load_metadata_array("init_score")
+        # sketch_s and bin_s stay 0: opening the format does ZERO
+        # re-binning work (the CI ooc-smoke gate asserts exactly this)
+        self._note_construct_stats("binned", rows=self.num_data,
+                                   chunks=reader.num_shards, sketch_s=0.0,
+                                   bin_s=0.0, write_s=0.0, workers=1,
+                                   rss_before=rss0,
+                                   load_s=round(_time.time() - t0, 6))
         return self
 
 

@@ -162,6 +162,13 @@ class GBDT:
                     "lgbm_dataset_bins_built_total",
                     "feature-discretization bins constructed for "
                     "training datasets").inc(int(np.sum(nbins)))
+            # construction-phase accounting captured by io/dataset.py and
+            # io/streaming.py: rows/chunks, sketch/bin/write phase
+            # seconds, peak RSS, workers — the schema-v9 event
+            # bench_compare gates (`construct_s`, --tol-construct)
+            cstats = getattr(self.train_data, "_construct_stats", None)
+            if cstats is not None:
+                self._obs.event("dataset_construct", **cstats)
             # data-quality profile captured at Dataset construction
             # (io/dataset.py _profile_quality); may Log.fatal under
             # obs_health=fatal on a degenerate dataset — before any
@@ -306,7 +313,11 @@ class GBDT:
         init = valid_data.metadata.init_score
         if init is not None:
             score[:] = np.asarray(init).reshape(k, valid_data.num_data)
-        Xv = jnp.asarray(valid_data.binned)
+        from ..ops.learner import paged_device_matrix
+        # out-of-core valid sets upload shard-by-shard (no host matrix)
+        Xv = paged_device_matrix(valid_data)
+        if Xv is None:
+            Xv = jnp.asarray(valid_data.binned)
         score_dev = jnp.asarray(score, self.score_dtype)
         self.valid_data.append(valid_data)
         self._valid_X_dev.append(Xv)

@@ -54,6 +54,11 @@ autotune_decision mode, source, cell (schema 8; ops/autotune.py — the
 wave_band_escape width_from, width_to (schema 8; ops/learner.py — the
                auto wave width escaped the measured pathological
                hist-block band; previously silent, BENCH_NOTES.md)
+dataset_construct rows, chunks, sketch_s, bin_s, write_s,
+               peak_rss_bytes, workers (schema 9; io/dataset.py +
+               io/streaming.py — one dataset construction: source kind,
+               two-pass phase seconds, worker-pool width, RSS watermark;
+               `construct_s` is gated by tools/bench_compare.py)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -89,12 +94,13 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
-# 5 (no serving events), 6 (no request traces / SLO snapshots) and
-# 7 (no autotune/band-escape events) timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
+# 5 (no serving events), 6 (no request traces / SLO snapshots),
+# 7 (no autotune/band-escape events) and 8 (no dataset_construct)
+# timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -143,6 +149,12 @@ _REQUIRED = {
     "autotune_probe": ("cell", "s_per_wave"),
     "autotune_decision": ("mode", "source", "cell"),
     "wave_band_escape": ("width_from", "width_to"),
+    # schema 9 (io/dataset.py + io/streaming.py): out-of-core ingest —
+    # one event per dataset construction with the two-pass phase split
+    # (quantile sketch / binning / shard write), chunk count, worker-pool
+    # width and the host RSS watermark; bench_compare gates construct_s
+    "dataset_construct": ("rows", "chunks", "sketch_s", "bin_s",
+                          "write_s", "peak_rss_bytes", "workers"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 

@@ -103,6 +103,23 @@ def build_split_params(config: Config) -> SplitParams:
     )
 
 
+def paged_device_matrix(train_data, row_pad: int = 0):
+    """Device bin matrix paged shard-by-shard from a binned-format mmap
+    reader (io/binned_format.py): the host never materializes the full
+    (N, G) matrix, so peak host RSS stays O(shard) for out-of-core
+    datasets.  Returns None when the dataset is not reader-backed —
+    callers fall back to the one-shot host upload."""
+    reader = getattr(train_data, "_binned_reader", None)
+    if reader is None or reader.num_columns == 0 or reader.num_data == 0:
+        return None
+    parts = [jnp.asarray(np.ascontiguousarray(view))
+             for _, view in reader.iter_shards()]
+    if row_pad:
+        parts.append(jnp.zeros((int(row_pad), reader.num_columns),
+                               parts[0].dtype))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
 class SerialTreeLearner:
     # run observer (lightgbm_tpu/obs); a class-level NULL default keeps
     # every constructor untouched and the disabled path allocation-free
@@ -473,18 +490,27 @@ class SerialTreeLearner:
             self.X = device_data
         else:
             from .pack import pack4_host
-            binned = train_data.binned
-            if binned.shape[1] == 0:        # dummy column (see meta above)
-                binned = np.zeros((train_data.num_data, 1), np.uint8)
-            n = binned.shape[0]
-            self._row_pad = (-n) % 1024
-            if self._row_pad:
-                binned = np.concatenate(
-                    [binned, np.zeros((self._row_pad, binned.shape[1]),
-                                      binned.dtype)])
-            if self.packed_cols:
-                binned = pack4_host(binned)
-            self.X = jnp.asarray(binned)
+            self._row_pad = (-train_data.num_data) % 1024
+            X = None
+            if not self.packed_cols:
+                # out-of-core datasets page shard-by-shard to the device —
+                # no padded full-size host copy is ever built
+                X = paged_device_matrix(train_data, self._row_pad)
+            if X is not None:
+                self.X = X
+            else:
+                binned = train_data.binned
+                if binned.shape[1] == 0:    # dummy column (see meta above)
+                    binned = np.zeros((train_data.num_data, 1), np.uint8)
+                n = binned.shape[0]
+                self._row_pad = (-n) % 1024
+                if self._row_pad:
+                    binned = np.concatenate(
+                        [binned, np.zeros((self._row_pad, binned.shape[1]),
+                                          binned.dtype)])
+                if self.packed_cols:
+                    binned = pack4_host(binned)
+                self.X = jnp.asarray(binned)
         if self._row_pad:
             self._ones = jnp.concatenate(
                 [jnp.ones(train_data.num_data, self.dtype),

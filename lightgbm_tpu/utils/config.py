@@ -68,6 +68,14 @@ ALIAS_TABLE: Dict[str, str] = {
     "mlist": "machine_list_file",
     "is_save_binary": "is_save_binary_file",
     "save_binary": "is_save_binary_file",
+    # out-of-core streaming ingest (io/streaming.py + io/binned_format.py)
+    "stream_chunk_rows": "ooc_chunk_rows",
+    "ooc_chunk": "ooc_chunk_rows",
+    "stream_workers": "ooc_workers",
+    "binning_workers": "ooc_workers",
+    "save_binned": "ooc_binned_dir",
+    "save_binned_dir": "ooc_binned_dir",
+    "binned_dir": "ooc_binned_dir",
     "early_stopping_rounds": "early_stopping_round",
     "early_stopping": "early_stopping_round",
     "verbosity": "verbose",
@@ -166,6 +174,7 @@ PARAMETER_SET = {
     "feature_fraction_seed", "enable_bundle", "data_filename",
     "valid_data_filenames", "snapshot_freq", "sparse_threshold",
     "enable_load_from_binary_file", "max_conflict_rate",
+    "ooc_chunk_rows", "ooc_workers", "ooc_binned_dir",
     "poisson_max_delta_step", "gaussian_eta", "histogram_pool_size",
     "output_freq", "is_provide_training_metric", "machine_list_filename",
     "capacity",
@@ -308,6 +317,13 @@ class Config:
         "is_save_binary_file": ("bool", False),
         "enable_load_from_binary_file": ("bool", True),
         "bin_construct_sample_cnt": ("int", 200000),
+        # out-of-core streaming ingest (io/streaming.py): row-chunk size
+        # for array/sparse sources, worker-pool width (0 = all cores),
+        # and an optional directory to persist the pre-binned mmap format
+        # (io/binned_format.py) during construction
+        "ooc_chunk_rows": ("int", 262144),
+        "ooc_workers": ("int", 0),
+        "ooc_binned_dir": ("str", ""),
         "is_predict_leaf_index": ("bool", False),
         "is_predict_raw_score": ("bool", False),
         "min_data_in_leaf": ("int", 20),

@@ -39,8 +39,10 @@ def test_bench_orchestrator_end_to_end():
     assert len(lines) == 1, r.stdout
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "final_eval_metric", "final_eval_name"}
+                        "final_eval_metric", "final_eval_name",
+                        "construct_s"}
     assert rec["value"] > 0
+    assert rec["construct_s"] is None or rec["construct_s"] >= 0
     assert rec["unit"] == "iters/sec"
     assert rec["final_eval_name"] == "auc"
     assert 0.0 < rec["final_eval_metric"] <= 1.0

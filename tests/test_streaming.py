@@ -111,3 +111,122 @@ def test_streaming_blank_lines(tmp_path):
     np.testing.assert_array_equal(td.binned, td2.binned)
     np.testing.assert_array_equal(np.asarray(td.metadata.label),
                                   np.asarray(td2.metadata.label))
+
+# --- out-of-core two-pass pipeline (PR 9): sketch merge, parallel
+# --- workers, chunk boundaries, missing values, sparse sources
+
+
+def test_sketch_merge_order_independent():
+    """Shuffled chunk order + split/merged sketches reassemble the exact
+    bytes of ``data[sample_idx]`` — the invariant that makes streamed
+    BinMapper fitting bit-identical to the one-shot path."""
+    import random as pyrandom
+
+    from lightgbm_tpu.io.streaming import SampleSketch
+
+    rng = np.random.default_rng(5)
+    data = rng.normal(size=(1000, 5))
+    idx = sorted(pyrandom.Random(1).sample(range(1000), 200))
+    bounds = [0, 137, 400, 401, 999, 1000]       # odd, incl. 1-row chunk
+    chunks = []
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        sel = [i - s for i in idx if s <= i < e]
+        chunks.append((s, data[s:e][sel]))
+    sk_a, sk_b = SampleSketch(5), SampleSketch(5)
+    for j in (3, 0, 4):
+        sk_a.add_chunk(*chunks[j])
+    for j in (1, 2):
+        sk_b.add_chunk(*chunks[j])
+    sk_a.merge(sk_b)
+    np.testing.assert_array_equal(sk_a.sample_matrix(), data[idx])
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_from_streamed_matrix_parity(workers):
+    """Streamed matrix construction (serial and through the fork pool)
+    == from_matrix bit for bit, NaNs included."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(3000, 6))
+    X[rng.random(X.shape) > 0.95] = np.nan       # missing values
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float64)
+    td_mem = TrainingData.from_matrix(
+        X, y, Config({"max_bin": 31, "verbose": -1}))
+    td_str = TrainingData.from_streamed(
+        X, y, Config({"max_bin": 31, "verbose": -1,
+                      "ooc_workers": workers}), chunk_rows=777)
+    np.testing.assert_array_equal(td_str.num_bin_arr, td_mem.num_bin_arr)
+    np.testing.assert_array_equal(td_str.binned, td_mem.binned)
+    np.testing.assert_array_equal(np.asarray(td_str.metadata.label),
+                                  np.asarray(td_mem.metadata.label))
+    st = td_str._construct_stats
+    assert st["source"] == "stream:matrix" and st["rows"] == 3000
+    assert st["chunks"] == 4 and st["workers"] >= 1
+
+
+@pytest.mark.parametrize("chunk_rows", [1, 3, 199, 200, 500])
+def test_streamed_chunk_boundaries(chunk_rows):
+    """Chunk size spanning the degenerate edges — 1-row chunks, a chunk
+    boundary exactly at n, and a single chunk bigger than the data."""
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = {"max_bin": 15, "verbose": -1, "min_data_in_bin": 1,
+           "min_data_in_leaf": 1}
+    td_mem = TrainingData.from_matrix(X, y, Config(dict(cfg)))
+    td_str = TrainingData.from_streamed(X, y, Config(dict(cfg)),
+                                        chunk_rows=chunk_rows)
+    np.testing.assert_array_equal(td_str.binned, td_mem.binned)
+    assert td_str._construct_stats["chunks"] == -(-200 // chunk_rows)
+
+
+def test_streamed_missing_token_text(tmp_path):
+    """'na' tokens in a text file take the streamed and in-memory loaders
+    through the same missing-value handling."""
+    rng = np.random.default_rng(29)
+    n = 1500
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(np.int64)
+    miss = rng.random((n, 3)) > 0.9
+    path = tmp_path / "miss.csv"
+    with open(path, "w") as fh:
+        for i in range(n):
+            cells = ["na" if miss[i, j] else "%.17g" % X[i, j]
+                     for j in range(3)]
+            fh.write("%d,%s\n" % (y[i], ",".join(cells)))
+    cfg = {"max_bin": 31, "verbose": -1, "use_missing": True}
+    td_mem = TrainingData.from_file(str(path), Config(dict(cfg)))
+    td_str = TrainingData.from_file(
+        str(path), Config(dict(cfg, use_two_round_loading=True,
+                               ooc_chunk_rows=256)))
+    np.testing.assert_array_equal(td_str.binned, td_mem.binned)
+    np.testing.assert_array_equal(np.asarray(td_str.metadata.label),
+                                  np.asarray(td_mem.metadata.label))
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_streamed_sparse_parity(workers):
+    """SparseSource densifies one chunk at a time; result must match the
+    all-at-once CSC ingest bit for bit."""
+    from lightgbm_tpu.io.sparse import SparseColumns
+
+    rng = np.random.default_rng(23)
+    n, f = 2500, 9
+    dense = rng.normal(size=(n, f))
+    dense[rng.random((n, f)) > 0.2] = 0.0
+    colptr, indices, values = [0], [], []
+    for j in range(f):
+        rows = np.nonzero(dense[:, j])[0]
+        indices.extend(rows.tolist())
+        values.extend(dense[rows, j].tolist())
+        colptr.append(len(indices))
+    sp = SparseColumns(np.asarray(colptr, dtype=np.int64),
+                       np.asarray(indices, dtype=np.int64),
+                       np.asarray(values, dtype=np.float64), n, f)
+    y = (dense[:, 0] > 0).astype(np.float64)
+    cfg = {"max_bin": 31, "verbose": -1}
+    td_csc = TrainingData.from_csc(sp, y, Config(dict(cfg)))
+    td_str = TrainingData.from_streamed(
+        sp, y, Config(dict(cfg, ooc_workers=workers)), chunk_rows=611)
+    np.testing.assert_array_equal(td_str.binned, td_csc.binned)
+    st = td_str._construct_stats
+    assert st["source"] == "stream:sparse" and st["chunks"] == 5

@@ -28,7 +28,8 @@ Usage:
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
         [--tol-recompile 0] [--tol-eval 0.02] \
         [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] \
-        [--tol-serve-shed 0.25] [--tol-autotune 0.50] [--json]
+        [--tol-serve-shed 0.25] [--tol-autotune 0.50] \
+        [--tol-construct 0.30] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -78,6 +79,11 @@ METRICS = {
     # the "second run on the same shape performs zero probe waves"
     # contract; measure-vs-measure runs tolerate 50% timer noise
     "autotune_overhead_s": (-1, 0.50),
+    # dataset construction wall seconds (summed over dataset_construct
+    # events, io/streaming.py two-pass ingest).  A pre-binned reload
+    # reports sketch_s == bin_s == 0, so candidate-vs-baseline catches
+    # both slow binning AND accidental re-binning of a binned artifact
+    "construct_s": (-1, 0.30),
 }
 
 
@@ -142,6 +148,15 @@ def _from_timeline(events):
     if decs:
         out["autotune_overhead_s"] = sum(
             float(e.get("overhead_s", 0.0)) for e in decs)
+    # dataset-construction cost (schema v9): sum over dataset_construct
+    # events of the run (train + valid sets all count toward the gate)
+    cons = [e for e in events if e.get("ev") == "dataset_construct"]
+    if cons:
+        out["construct_s"] = sum(
+            float(e.get("construct_s",
+                        e.get("sketch_s", 0.0) + e.get("bin_s", 0.0)
+                        + e.get("write_s", 0.0)))
+            for e in cons)
     return out
 
 
@@ -162,6 +177,8 @@ def _from_parsed(parsed):
         out["serve_p99_s"] = float(parsed["serve_p99_s"])
     if parsed.get("serve_shed_rate") is not None:
         out["serve_shed_rate"] = float(parsed["serve_shed_rate"])
+    if parsed.get("construct_s") is not None:
+        out["construct_s"] = float(parsed["construct_s"])
     return out
 
 
@@ -265,6 +282,11 @@ def main(argv=None):
         "autotune_overhead_s"][1],
         help="autotune probe-overhead relative tolerance (a warm-cache "
              "zero-overhead baseline fails on ANY candidate probing)")
+    ap.add_argument("--tol-construct", type=float, default=METRICS[
+        "construct_s"][1],
+        help="dataset-construction time relative tolerance (a "
+             "pre-binned zero-rebin baseline fails on ANY candidate "
+             "re-binning)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
@@ -275,7 +297,8 @@ def main(argv=None):
             "serve_qps": args.tol_serve_qps,
             "serve_p99_s": args.tol_serve_p99,
             "serve_shed_rate": args.tol_serve_shed,
-            "autotune_overhead_s": args.tol_autotune}
+            "autotune_overhead_s": args.tol_autotune,
+            "construct_s": args.tol_construct}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

@@ -218,6 +218,16 @@ NOTES = {
                         "entropy, constant/near-constant/ID-like "
                         "flags, label balance) into a data_profile "
                         "event; findings route through obs_health",
+    "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
+                      "(the host-memory budget unit; text chunks size "
+                      "to it via a bytes-per-row estimate) — see "
+                      "OutOfCore.md",
+    "ooc_workers": "parallel two-pass binning worker processes "
+                   "(0 = all cores; 1 or no fork support = serial)",
+    "ooc_binned_dir": "stream the training file into this pre-binned "
+                      "mmap-able dataset directory during "
+                      "construction; later runs can train straight "
+                      "from the directory with zero re-binning",
 }
 
 GROUPS = [
@@ -244,7 +254,8 @@ GROUPS = [
         "is_enable_sparse", "sparse_threshold", "use_missing",
         "enable_bundle", "max_conflict_rate", "input_model",
         "output_model", "output_result", "snapshot_freq", "verbose",
-        "metric_freq", "is_training_metric"]),
+        "metric_freq", "is_training_metric", "ooc_chunk_rows",
+        "ooc_workers", "ooc_binned_dir"]),
     ("Prediction", [
         "num_iteration_predict", "is_predict_raw_score",
         "is_predict_leaf_index", "pred_early_stop", "pred_early_stop_freq",

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""CI smoke for the out-of-core streaming ingest (io/streaming.py +
+io/binned_format.py) — on CPU.
+
+Exercises the full two-pass CSV pipeline end to end:
+
+  run 1 (stream + persist): a synthetic CSV is streamed chunk-by-chunk
+         through the parallel sketch/bin worker pool (ooc_workers=2)
+         straight into a pre-binned mmap-able directory
+         (ooc_binned_dir), and a model is trained on it;
+  run 2 (pre-binned reload): training is pointed at the binned
+         directory; the dataset_construct event must report
+         sketch_s == bin_s == 0 (ZERO re-binning — the contract
+         bench_compare's construct_s metric gates) and the trained
+         model must be byte-identical to run 1's.
+
+Finishes with a bench_compare self-compare of the run-2 timeline so
+the construct_s extraction path is exercised by CI too.  Exits nonzero
+on any violation.  See docs/OutOfCore.md.
+
+Usage: python tools/ooc_smoke.py [WORKDIR]
+(WORKDIR keeps the timelines for artifact upload; default: a tempdir.)
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_ROWS, N_COLS = 4000, 10
+
+
+def events_of(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def write_csv(path, rng):
+    import numpy as np
+    X = rng.standard_normal((N_ROWS, N_COLS)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    with open(path, "w") as f:
+        for i in range(N_ROWS):
+            f.write("%d,%s\n" % (y[i],
+                                 ",".join("%.6g" % v for v in X[i])))
+
+
+def main():
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    fails = []
+
+    def check(cond, msg):
+        if not cond:
+            fails.append(msg)
+            print("FAIL: %s" % msg)
+
+    work = sys.argv[1] if len(sys.argv) > 1 else None
+    tmp_ctx = tempfile.TemporaryDirectory() if work is None else None
+    work = work or tmp_ctx.name
+    os.makedirs(work, exist_ok=True)
+
+    csv = os.path.join(work, "ooc_train.csv")
+    bindir = os.path.join(work, "ooc_binned")
+    ev1_path = os.path.join(work, "ooc_run1.jsonl")
+    ev2_path = os.path.join(work, "ooc_run2.jsonl")
+    write_csv(csv, rng)
+
+    base = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+            "min_data_in_leaf": 5, "verbose": -1}
+
+    # run 1: stream the CSV through the two-pass pipeline into bindir
+    p1 = dict(base, ooc_binned_dir=bindir, ooc_workers=2,
+              ooc_chunk_rows=512, obs_events_path=ev1_path)
+    b1 = lgb.train(p1, lgb.Dataset(csv, params=p1), num_boost_round=3)
+    ev1 = events_of(ev1_path)
+    c1 = [e for e in ev1 if e.get("ev") == "dataset_construct"]
+    check(len(c1) == 1, "run1: expected 1 dataset_construct, got %d"
+          % len(c1))
+    if c1:
+        check(c1[0].get("source") == "stream:text",
+              "run1: source %r != 'stream:text'" % c1[0].get("source"))
+        check(c1[0].get("rows") == N_ROWS,
+              "run1: rows %r != %d" % (c1[0].get("rows"), N_ROWS))
+        check(c1[0].get("chunks", 0) > 1,
+              "run1: expected multi-chunk streaming, got %r chunks"
+              % c1[0].get("chunks"))
+    check(os.path.isfile(os.path.join(bindir, "header.json")),
+          "binned dir missing header.json")
+
+    # run 2: retrain straight from the pre-binned directory
+    p2 = dict(base, obs_events_path=ev2_path)
+    b2 = lgb.train(p2, lgb.Dataset(bindir, params=p2), num_boost_round=3)
+    ev2 = events_of(ev2_path)
+    c2 = [e for e in ev2 if e.get("ev") == "dataset_construct"]
+    check(len(c2) == 1, "run2: expected 1 dataset_construct, got %d"
+          % len(c2))
+    if c2:
+        check(c2[0].get("source") == "binned",
+              "run2: source %r != 'binned'" % c2[0].get("source"))
+        check(c2[0].get("sketch_s") == 0 and c2[0].get("bin_s") == 0,
+              "run2: pre-binned reload re-binned the data "
+              "(sketch_s=%r bin_s=%r)" % (c2[0].get("sketch_s"),
+                                          c2[0].get("bin_s")))
+    check(b1.model_to_string() == b2.model_to_string(),
+          "model trained from binned dir differs from streamed run")
+
+    # bench_compare must extract construct_s from the timeline and a
+    # self-compare must pass
+    cmp_cmd = [sys.executable,
+               os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_compare.py"),
+               ev2_path, ev2_path, "--json"]
+    r = subprocess.run(cmp_cmd, capture_output=True, text=True)
+    check(r.returncode == 0, "bench_compare self-compare failed (rc=%d):"
+          " %s" % (r.returncode, r.stderr.strip()))
+    if r.returncode == 0:
+        verdict = json.loads(r.stdout)
+        names = [m["metric"] for m in verdict.get("metrics", [])]
+        check("construct_s" in names,
+              "bench_compare did not extract construct_s: %r" % names)
+
+    if tmp_ctx is not None:
+        tmp_ctx.cleanup()
+    if fails:
+        print("ooc smoke: %d failure(s)" % len(fails))
+        return 1
+    print("ooc smoke: OK (streamed %d rows in %d chunks -> %s; "
+          "reload sketch_s=%s bin_s=%s; models identical)"
+          % (N_ROWS, c1[0]["chunks"], os.path.basename(bindir),
+             c2[0]["sketch_s"], c2[0]["bin_s"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
