@@ -334,6 +334,12 @@ def child():
     except OSError:
         pass
     params.update({"obs_events_path": obs_path, "obs_timing": "iter"})
+    # land the finished run in the cross-run ledger (obs/ledger.py) so
+    # `obs trend` / bench_compare --baseline rolling see the history;
+    # LGBM_TPU_LEDGER="" disables, any failure only logs a warning
+    from lightgbm_tpu.obs.ledger import default_ledger_dir
+    params.update({"obs_ledger_dir": default_ledger_dir(),
+                   "obs_ledger_suite": "bench"})
     # the one-core data gen + binning costs minutes per attempt; cache the
     # BINNED dataset (atomic publish) so tunnel-wedge retries skip it.
     # Any cache problem falls back to a fresh build — the cache must never
@@ -448,12 +454,16 @@ def dry():
         os.unlink(obs_path)
     except OSError:
         pass
+    from lightgbm_tpu.obs.ledger import Ledger, default_ledger_dir
+    ledger_dir = default_ledger_dir()
     params = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
               "verbose": -1, "obs_events_path": obs_path,
               "obs_timing": "iter", "obs_memory_every": 2,
               "obs_health": "warn", "obs_metrics_every": 2,
               "obs_compile": True, "obs_split_audit": True,
-              "obs_importance_every": 2}
+              "obs_importance_every": 2,
+              "obs_ledger_dir": ledger_dir,
+              "obs_ledger_suite": "bench_dry"}
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
 
     # bucketed device predict: varying batch sizes must land on the
@@ -555,9 +565,28 @@ def dry():
             "pre-binned round trip changed bin ids"
     finally:
         shutil.rmtree(out, ignore_errors=True)
+
+    # cross-run ledger (obs/ledger.py): the clean close above must have
+    # ingested this run, and repeated --dry runs accumulate history —
+    # the instrument `obs trend --check` and --baseline rolling gate on
+    ledger_entries = []
+    if ledger_dir:
+        ledger_entries = Ledger(ledger_dir).entries()
+        this_run = evs[-1]["run"]
+        mine = [r for r in ledger_entries if r["run"] == this_run]
+        assert mine, "finished dry run %s missing from ledger %s" \
+            % (this_run, ledger_dir)
+        assert mine[0]["metrics"].get("iters_per_sec", 0) > 0, \
+            "ledger record carries no iters_per_sec: %r" \
+            % mine[0]["metrics"]
+        assert mine[0]["schema"] and "provenance" in \
+            next(e for e in evs if e["ev"] == "run_header"), \
+            "run_header missing provenance (schema 10)"
     print(json.dumps({"status": "dry_ok", "events": len(evs),
                       "iters": len(iter_recs), "health": len(health),
                       "metrics": len(metric_recs),
+                      "ledger_dir": ledger_dir,
+                      "ledger_entries": len(ledger_entries),
                       "compile_attr": len(attr),
                       "autotune_decisions": len(decs),
                       "dataset_construct": len(cons),

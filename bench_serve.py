@@ -150,6 +150,10 @@ def main(argv=None):
     ap.add_argument("--obs-path", default=None,
                     help="serve timeline path (default /tmp/bench_serve_"
                          "obs_<pid>.jsonl)")
+    ap.add_argument("--ledger", default=None,
+                    help="cross-run ledger directory (default "
+                         "LGBM_TPU_LEDGER or /tmp/lgbm_tpu_ledger; "
+                         "empty string disables ingestion)")
     args = ap.parse_args(argv)
 
     from lightgbm_tpu.utils.common import honor_jax_platforms
@@ -175,7 +179,13 @@ def main(argv=None):
     # sampled serve_batch trail for postmortems
     import jax
     from lightgbm_tpu.obs import RunObserver
-    obs = RunObserver(events_path=obs_path, compile_attr=True)
+    from lightgbm_tpu.obs.ledger import default_ledger_dir
+    ledger_dir = (default_ledger_dir() if args.ledger is None
+                  else args.ledger)
+    obs = RunObserver(events_path=obs_path, compile_attr=True,
+                      ledger_dir=ledger_dir,
+                      ledger_suite="serve_overload" if args.overload
+                      else "serve")
     obs.run_header(backend=jax.default_backend(),
                    devices=[str(d) for d in jax.local_devices()],
                    params={"requests": requests, "threads": args.threads,

@@ -45,7 +45,14 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
 * ``watchdog`` — hang watchdog + flight recorder: no progress within
   ``obs_watchdog_secs`` (or SIGTERM, or an ``obs_health=fatal`` abort)
   dumps the event ring buffer, all thread stacks, device memory and a
-  metrics snapshot to ``<events_path>.flight.json``.
+  metrics snapshot to ``<events_path>.flight.json``;
+* ``ledger``  — cross-run performance ledger: finished timelines land
+  as per-run metric records in an append-only crash-safe store
+  (``obs_ledger_dir`` / ``LGBM_TPU_LEDGER``), keyed by suite / shape /
+  device kind + the run_header provenance (git rev, schema 10); rolling
+  median/MAD baselines feed ``tools/bench_compare.py --baseline
+  rolling`` and the ``obs history`` / ``obs trend --check`` CLI flags
+  change-points attributed to the git rev that introduced them.
 
 Distributed runs are rank-native (schema 4): each rank writes its own
 timeline shard (``obs_events_path`` + ``.r{rank}``), every event
@@ -57,24 +64,28 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_flush_every``, ``obs_fsync``, ``obs_health*``, ``obs_metrics*``,
 ``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``,
 ``obs_watchdog_secs``, ``obs_flight_events``, ``obs_split_audit``,
-``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``.
+``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``,
+``obs_ledger_dir``, ``obs_ledger_suite``, ``obs_ledger_window``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
 
 from .events import (NULL_OBSERVER, SCHEMA_VERSION, EventWriter,
                      NullObserver, RingBuffer, RunObserver,
-                     current_observer, read_events, resolve_rank_path,
-                     validate_event)
+                     collect_provenance, current_observer, read_events,
+                     resolve_rank_path, validate_event)
 from .health import HealthMonitors
+from .ledger import (Ledger, default_ledger_dir, metrics_from_events,
+                     rolling_stats)
 from .metrics import REGISTRY, MetricsRegistry
 from ..utils.log import Log
 
 __all__ = ["NULL_OBSERVER", "NullObserver", "RunObserver", "EventWriter",
            "RingBuffer", "SCHEMA_VERSION", "read_events", "validate_event",
-           "current_observer", "resolve_rank_path",
+           "current_observer", "resolve_rank_path", "collect_provenance",
            "observer_from_config", "HealthMonitors", "MetricsRegistry",
-           "REGISTRY"]
+           "REGISTRY", "Ledger", "default_ledger_dir",
+           "metrics_from_events", "rolling_stats"]
 
 _TIMING_MODES = ("auto", "phase", "iter", "off")
 _HEALTH_MODES = ("off", "warn", "fatal")
@@ -100,9 +111,11 @@ def observer_from_config(config, comm=None):
     Any of ``obs_events_path`` / ``obs_trace_iters`` / ``obs_memory_every``
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
     ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every`` /
-    ``obs_split_audit`` / ``obs_importance_every`` enables the observer;
-    health, metrics, compile and model tracking work without an events
-    path (in-memory timeline via Booster.telemetry()).
+    ``obs_split_audit`` / ``obs_importance_every`` / ``obs_ledger_dir``
+    enables the observer; health, metrics, compile and model tracking
+    work without an events path (in-memory timeline via
+    Booster.telemetry()).  A non-empty ``obs_ledger_dir`` additionally
+    ingests the finished run into the cross-run ledger on clean close.
     """
     events_path = str(getattr(config, "obs_events_path", "") or "")
     trace_iters = str(getattr(config, "obs_trace_iters", "") or "")
@@ -118,11 +131,12 @@ def observer_from_config(config, comm=None):
     straggler_every = int(getattr(config, "obs_straggler_every", 0) or 0)
     split_audit = bool(getattr(config, "obs_split_audit", False))
     importance_every = int(getattr(config, "obs_importance_every", 0) or 0)
+    ledger_dir = str(getattr(config, "obs_ledger_dir", "") or "")
     if (not events_path and not trace_iters and memory_every <= 0
             and health_mode == "off" and not metrics_path
             and metrics_every <= 0 and not compile_attr
             and straggler_every <= 0 and not split_audit
-            and importance_every <= 0):
+            and importance_every <= 0 and not ledger_dir):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -169,4 +183,8 @@ def observer_from_config(config, comm=None):
                            or 0.0),
                        flight_events=int(
                            getattr(config, "obs_flight_events", 256)
-                           or 256))
+                           or 256),
+                       ledger_dir=ledger_dir,
+                       ledger_suite=str(
+                           getattr(config, "obs_ledger_suite", "")
+                           or ""))

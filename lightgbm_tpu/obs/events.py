@@ -9,6 +9,9 @@ Event types and their required keys (beyond ev/t/run):
 
 =============  =========================================================
 run_header     schema, backend, devices, params, context, timing
+               (+ provenance — git_rev/git_dirty/hostname/argv — from
+               schema 10 on: the attribution key the cross-run ledger
+               in obs/ledger.py groups and blames regressions by)
 iter           it, time_s, phases, fenced
 compile        entry, first_call_s, fenced
 compile_attr   entry, n_compiles, sig (schema 3; obs/compile.py — per-
@@ -86,6 +89,9 @@ import atexit
 import collections
 import json
 import os
+import socket
+import subprocess
+import sys
 import threading
 import time
 
@@ -94,13 +100,13 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
-# 7 (no autotune/band-escape events) and 8 (no dataset_construct)
-# timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+# 7 (no autotune/band-escape events), 8 (no dataset_construct) and
+# 9 (no run_header provenance) timelines still parse
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -157,6 +163,56 @@ _REQUIRED = {
                           "write_s", "peak_rss_bytes", "workers"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
+
+
+# -- run provenance ------------------------------------------------------
+# Stamped into every schema-10 run_header: the git rev (and whether the
+# tree was dirty), the host, and the CLI argv that launched the run.
+# This is the attribution key of the cross-run ledger (obs/ledger.py) —
+# a change-point in a metric trend is blamed on the first git rev that
+# shifted it — and on its own turns any flight record into "what code,
+# where, launched how".  Cached per process: two git subprocesses once,
+# never on the hot path.
+_PROVENANCE = None
+_PROVENANCE_LOCK = threading.Lock()
+
+
+def _git(args):
+    out = subprocess.run(["git"] + args, capture_output=True, text=True,
+                         timeout=10)
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr.strip() or "git rc=%d"
+                           % out.returncode)
+    return out.stdout
+
+
+def collect_provenance(refresh=False):
+    """{git_rev, git_dirty, hostname, argv} of this process, cached.
+
+    Best-effort by design: outside a git work tree (or with git missing)
+    ``git_rev`` is ``""`` and ``git_dirty`` False — a run observer must
+    never fail because of where it was launched from."""
+    global _PROVENANCE
+    with _PROVENANCE_LOCK:
+        if _PROVENANCE is not None and not refresh:
+            return dict(_PROVENANCE)
+        rev, dirty = "", False
+        try:
+            rev = _git(["rev-parse", "--short=12", "HEAD"]).strip()
+            dirty = bool(_git(["status", "--porcelain",
+                               "--untracked-files=no"]).strip())
+        except Exception:
+            rev, dirty = rev or "", bool(dirty)
+        try:
+            host = socket.gethostname()
+        except Exception:
+            host = ""
+        # bounded: argv can carry huge inline configs; the ledger and
+        # flight records only need "what command was this"
+        argv = [str(a)[:200] for a in sys.argv[:16]]
+        _PROVENANCE = {"git_rev": rev, "git_dirty": dirty,
+                       "hostname": host, "argv": argv}
+        return dict(_PROVENANCE)
 
 
 def resolve_rank_path(path, rank, world_size):
@@ -293,8 +349,15 @@ def validate_event(rec, strict=False):
     missing = [k for k in _REQUIRED[ev] if k not in rec]
     if missing:
         raise ValueError("event %r missing keys %s" % (ev, missing))
-    if ev == "run_header" and rec["schema"] not in _ACCEPTED_SCHEMAS:
-        raise ValueError("unsupported schema version %r" % (rec["schema"],))
+    if ev == "run_header":
+        if rec["schema"] not in _ACCEPTED_SCHEMAS:
+            raise ValueError("unsupported schema version %r"
+                             % (rec["schema"],))
+        # schema 10 declares run provenance; older headers predate it
+        if isinstance(rec["schema"], int) and rec["schema"] >= 10 \
+                and "provenance" not in rec:
+            raise ValueError("run_header schema %r missing provenance"
+                             % (rec["schema"],))
     return rec
 
 
@@ -461,7 +524,7 @@ class RunObserver(NullObserver):
                  compile_attr=False, straggler_every=0,
                  straggler_warn_skew=0.5, rank=None, world_size=None,
                  coordinator="", fsync=False, watchdog_secs=0.0,
-                 flight_events=256):
+                 flight_events=256, ledger_dir="", ledger_suite=""):
         from . import metrics as metrics_mod
         if rank is None or world_size is None:
             info = _default_rank_info()
@@ -510,6 +573,8 @@ class RunObserver(NullObserver):
             "(fencing per obs_timing)")
         self._m_iters = self._registry.counter(
             "lgbm_train_iterations_total", "boosting iterations completed")
+        self._ledger_dir = str(ledger_dir or "")
+        self._ledger_suite = str(ledger_suite or "")
         self._watchdog = None
         if float(watchdog_secs or 0.0) > 0.0:
             from .watchdog import Watchdog
@@ -536,7 +601,8 @@ class RunObserver(NullObserver):
                    devices=devices, params=params, context=context,
                    timing=self.timing, rank=self.rank,
                    world_size=self.world_size,
-                   coordinator=self.coordinator)
+                   coordinator=self.coordinator,
+                   provenance=collect_provenance())
 
     # -- per-iteration hooks ------------------------------------------
     def iter_begin(self, it):
@@ -715,6 +781,20 @@ class RunObserver(NullObserver):
             self._writer.close()
             Log.debug("obs: wrote %d events to %s", len(self.timeline),
                       self._writer.path)
+        # cross-run ledger (obs_ledger_dir): only CLEAN runs become
+        # baseline history — an aborted run's partial metrics would
+        # poison the rolling statistics.  Best-effort: the ledger must
+        # never take a finished run down.
+        if self._ledger_dir and status == "ok":
+            try:
+                from .ledger import Ledger
+                if Ledger(self._ledger_dir).ingest_events(
+                        list(self.timeline), suite=self._ledger_suite):
+                    Log.debug("obs: run %s ingested into ledger %s",
+                              self.run_id, self._ledger_dir)
+            except Exception as e:
+                Log.warning("obs: ledger ingest into %s failed: %s",
+                            self._ledger_dir, e)
 
     def _finalize_at_exit(self):
         """atexit hook: a run that never reached finalize (crash, sys.exit,

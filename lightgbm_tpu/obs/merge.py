@@ -40,7 +40,7 @@ import glob
 import json
 import os
 
-from .events import SCHEMA_VERSION, read_events
+from .events import SCHEMA_VERSION, collect_provenance, read_events
 
 __all__ = ["discover_shards", "load_shards", "merge_shards",
            "render_report", "write_merged"]
@@ -228,6 +228,7 @@ def merge_shards(shards):
          devices=h0.get("devices", []), params=h0.get("params", {}),
          context=h0.get("context", {}), timing=h0.get("timing", "?"),
          rank=-1, world_size=world, coordinator=h0.get("coordinator", ""),
+         provenance=h0.get("provenance") or collect_provenance(),
          merged=True, merged_ranks=ranks)
 
     for row in coll_rows:

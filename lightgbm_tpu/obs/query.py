@@ -32,7 +32,13 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   by side with deltas (informational; ``tools/bench_compare.py`` is the
   tolerance-gated verdict);
 * ``trace RUN.jsonl -o t.json`` — Chrome/Perfetto ``trace.json``
-  reconstructed from the phase-timer laps (load in ui.perfetto.dev).
+  reconstructed from the phase-timer laps (load in ui.perfetto.dev);
+* ``history [LEDGER]``        — the cross-run ledger (obs/ledger.py):
+  one line per recorded bench run, newest last;
+* ``trend [LEDGER] [--check]`` — per-cell per-metric trend tables with
+  sparklines and change-point attribution to the recorded git rev;
+  ``--check`` exits 1 when any gated metric's current regime began
+  with a bad-direction shift — the cross-run CI gate.
 
 Schema v1/v2 timelines load unchanged — the new event types simply
 don't appear.
@@ -639,7 +645,50 @@ def main(argv=None):
                                      "phase laps")
     p.add_argument("timeline")
     p.add_argument("-o", "--out", default="trace.json")
+    for name, hlp in (("history", "cross-run ledger: one line per "
+                                  "recorded bench run"),
+                      ("trend", "per-metric trend tables, sparklines + "
+                                "change-point attribution")):
+        p = sub.add_parser(name, help=hlp)
+        p.add_argument("ledger", nargs="?", default="",
+                       help="ledger directory (default: LGBM_TPU_LEDGER "
+                            "or /tmp/lgbm_tpu_ledger)")
+        p.add_argument("--suite", default="",
+                       help="restrict to one ledger suite")
+        p.add_argument("--metric", default="",
+                       help="restrict to one metric")
+        if name == "history":
+            p.add_argument("-n", "--limit", type=int, default=20,
+                           help="show the last N runs")
+        else:
+            p.add_argument("--window", type=int, default=8,
+                           help="rolling-baseline window")
+            p.add_argument("--min-history", type=int, default=3,
+                           help="runs required before change-point "
+                                "detection engages")
+            p.add_argument("--z", type=float, default=3.0,
+                           help="change-point z-score threshold")
+            p.add_argument("--check", action="store_true",
+                           help="exit 1 when a gated metric's current "
+                                "regime began with a bad-direction "
+                                "shift — the cross-run CI gate")
     args = ap.parse_args(argv)
+
+    if args.cmd in ("history", "trend"):
+        from .ledger import Ledger, default_ledger_dir
+        from .ledger import render_history, render_trend
+        path = args.ledger or default_ledger_dir()
+        entries = Ledger(path).entries()
+        if args.cmd == "history":
+            render_history(entries, limit=args.limit,
+                           suite=args.suite or None,
+                           metric=args.metric or None)
+            return 0
+        active = render_trend(entries, suite=args.suite or None,
+                              metric=args.metric or None,
+                              window=args.window, z_threshold=args.z,
+                              min_history=args.min_history)
+        return 1 if (args.check and active) else 0
 
     try:
         if args.cmd == "merge":

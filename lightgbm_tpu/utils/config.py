@@ -124,6 +124,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_importance_k": "obs_importance_topk",
     "obs_profile_data": "obs_data_profile",
     "obs_dataset_profile": "obs_data_profile",
+    "obs_ledger": "obs_ledger_dir",
+    "ledger_dir": "obs_ledger_dir",
+    "ledger_suite": "obs_ledger_suite",
+    "ledger_window": "obs_ledger_window",
+    "obs_ledger_n": "obs_ledger_window",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -196,6 +201,8 @@ PARAMETER_SET = {
     "obs_watchdog_secs", "obs_fsync", "obs_flight_events",
     "obs_split_audit", "obs_importance_every", "obs_importance_topk",
     "obs_data_profile",
+    # cross-run performance ledger (obs/ledger.py)
+    "obs_ledger_dir", "obs_ledger_suite", "obs_ledger_window",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -612,6 +619,21 @@ class Config:
         # obs_health channel (warn logs, fatal aborts naming the
         # feature).  Does NOT enable the observer by itself.
         "obs_data_profile": ("bool", True),
+        # cross-run performance ledger (obs/ledger.py): directory the
+        # observer ingests finished runs into on clean close (append-only
+        # JSONL index + per-run records; crash-safe tmp+replace writes).
+        # Empty = no automatic ingestion.  bench.py points this at
+        # LGBM_TPU_LEDGER (default /tmp/lgbm_tpu_ledger) so every bench
+        # run lands in history; `obs trend --check` and bench_compare
+        # --baseline rolling gate against it.  Turns the observer on.
+        "obs_ledger_dir": ("str", ""),
+        # ledger suite label of this run — the coarse comparability key
+        # rolling baselines group by (e.g. 'bench', 'serve',
+        # 'suite_tall').  Empty = the run_header context tool name.
+        "obs_ledger_suite": ("str", ""),
+        # rolling-baseline window: median/MAD statistics cover the last
+        # N comparable clean runs of the same (suite, shape, device) cell
+        "obs_ledger_window": ("int", 8),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

@@ -20,11 +20,24 @@ Accepted artifact kinds (auto-detected per file):
 
 Direction is per metric: iters/sec regresses when the candidate drops
 below baseline x (1 - tol); compile time and peak memory regress when
-the candidate exceeds baseline x (1 + tol).  Metrics present in only one
-artifact are reported and skipped; no overlap at all is a usage error.
+the candidate exceeds baseline x (1 + tol).  A ZERO baseline breaks the
+relative form, so those cells gate on the absolute delta instead: any
+bad-direction move past the (default 0) zero-baseline epsilon regresses.
+Metrics present in only one artifact are reported and skipped; no
+overlap at all is a usage error.
+
+``--baseline rolling`` swaps the single parent for the cross-run ledger
+(lightgbm_tpu/obs/ledger.py): each candidate metric is z-scored against
+the median/MAD of the last N comparable clean runs (same suite/shape
+filters) and regresses when it sits beyond ``--z`` noise-floored sigmas
+in the bad direction.  Metrics with fewer than ``--min-history``
+comparable runs fall back to the positional parent compare with a
+stderr notice — thin history must not silently pass.
 
 Usage:
     python tools/bench_compare.py BASELINE CANDIDATE \
+        [--baseline rolling --ledger DIR --suite NAME --shape NxF \
+         --window 8 --min-history 3 --z 3.0] \
         [--tol-ips 0.08] [--tol-compile 0.25] [--tol-mem 0.10] \
         [--tol-recompile 0] [--tol-eval 0.02] \
         [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] \
@@ -35,6 +48,7 @@ Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
 import argparse
 import json
+import os
 import sys
 
 EXIT_CODES = """\
@@ -225,9 +239,13 @@ class SystemExit2(Exception):
     """Load/usage failure -> exit 2 (distinct from regression -> 1)."""
 
 
-def compare(base, cand, tols):
-    """[(metric, base, cand, delta_frac, regressed, tol)] over the
-    metrics present in both artifacts."""
+def compare(base, cand, tols, zero_eps=None):
+    """[(metric, base, cand, delta, regressed, tol)] over the metrics
+    present in both artifacts.  ``delta`` is the relative change except
+    against a zero baseline, where it is the finite ABSOLUTE delta
+    (`c - b`) and gating switches to the per-metric ``zero_eps``
+    epsilon (default 0: any bad-direction move regresses)."""
+    zero_eps = zero_eps or {}
     rows = []
     for name, (direction, _) in METRICS.items():
         if name not in base or name not in cand:
@@ -235,17 +253,112 @@ def compare(base, cand, tols):
         b, c = float(base[name]), float(cand[name])
         tol = tols.get(name, METRICS[name][1])
         if b == 0:
-            # a zero baseline breaks the relative form; any nonzero
-            # lower-is-better candidate (e.g. recompile_count 0 -> 2)
-            # exceeds every relative tolerance and must regress
-            delta = 0.0 if c == 0 else float("inf")
-            regressed = direction < 0 and c > 0
+            # a zero baseline breaks the relative form (and the old
+            # inf delta broke --json); gate on the absolute delta in
+            # BOTH directions: recompile_count 0 -> 2 regresses, and so
+            # does a higher-is-better metric going 0 -> negative
+            eps = float(zero_eps.get(name, 0.0))
+            delta = c - b
+            regressed = (direction < 0 and c > eps) or \
+                        (direction > 0 and c < -eps)
         else:
             delta = (c - b) / b
             regressed = (direction > 0 and c < b * (1.0 - tol)) or \
                         (direction < 0 and c > b * (1.0 + tol))
         rows.append((name, b, c, delta, regressed, tol))
     return rows
+
+
+# ------------------------------------------------- rolling-ledger gating
+
+def _ledger_mod():
+    """Import lightgbm_tpu.obs.ledger from the repo this script lives
+    in — lazy, so parent-compare runs never touch the package."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from lightgbm_tpu.obs import ledger
+    return ledger
+
+
+def _candidate_cell(path, led):
+    """Ledger identity of the candidate timeline: {run, suite, shape,
+    device_kind}, or None for non-timeline artifacts.  Derived the same
+    way ingestion derives it (header params / context / shape bucket),
+    so an un-flagged rolling compare gates against the candidate's OWN
+    cell instead of pooling every suite in the ledger."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    events, run = [], None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return None
+        if not isinstance(rec, dict) or not rec.get("ev"):
+            return None
+        events.append(rec)
+        run = rec.get("run", run)
+    if not events:
+        return None
+    events = [e for e in events if e.get("run", run) == run]
+    header = next((e for e in events if e.get("ev") == "run_header"), {})
+    params = header.get("params") or {}
+    ctx = header.get("context") or {}
+    suite = str(params.get("obs_ledger_suite") or ctx.get("tool")
+                or ctx.get("suite") or "")
+    return {"run": run, "suite": suite,
+            "shape": led._shape_bucket(events, header),
+            "device_kind": led._device_kind(header)}
+
+
+def rolling_rows(args, tols, base, cand):
+    """Rows gated against the ledger's rolling baseline.  Returns
+    (rows, modes): rows shaped like compare()'s, modes[name] one of
+    'rolling' (z-gate, base column = rolling median) or 'parent'
+    (thin history -> positional-parent fallback, noticed on stderr)."""
+    led = _ledger_mod()
+    ledger = led.Ledger(args.ledger or led.default_ledger_dir())
+    entries = ledger.entries()
+    cell = _candidate_cell(args.candidate, led) or {}
+    exclude = {cell["run"]} if cell.get("run") else set()
+    suite = args.suite or cell.get("suite") or None
+    shape = args.shape or cell.get("shape") or None
+    device_kind = cell.get("device_kind") or None
+    rows, modes = [], {}
+    for name, (direction, _) in METRICS.items():
+        if name not in cand:
+            continue
+        c = float(cand[name])
+        comp = led.comparable_entries(
+            entries, suite=suite, shape=shape, device_kind=device_kind,
+            metric=name, exclude_runs=exclude)
+        vals = [float(r["metrics"][name]) for r in comp]
+        if len(vals) >= args.min_history:
+            st = led.rolling_stats(vals, args.window)
+            z = (c - st["median"]) / st["sigma"]
+            regressed = direction * z < -args.z
+            delta = (c - st["median"]) / st["median"] \
+                if st["median"] else c - st["median"]
+            rows.append((name, st["median"], c, delta, regressed, z))
+            modes[name] = "rolling"
+        elif name in base:
+            print("notice: %s has %d comparable ledger run(s) "
+                  "(< %d): falling back to parent compare"
+                  % (name, len(vals), args.min_history), file=sys.stderr)
+            rows.extend(compare({name: base[name]}, {name: c}, tols))
+            modes[name] = "parent"
+        else:
+            print("notice: %s has %d comparable ledger run(s) "
+                  "(< %d) and no parent value: skipped"
+                  % (name, len(vals), args.min_history), file=sys.stderr)
+    return rows, modes
 
 
 def main(argv=None):
@@ -256,6 +369,29 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("baseline")
     ap.add_argument("candidate")
+    ap.add_argument("--baseline", dest="baseline_mode",
+                    choices=("parent", "rolling"), default="parent",
+                    help="gate source: 'parent' compares against the "
+                         "positional baseline artifact; 'rolling' "
+                         "z-scores against the run ledger's rolling "
+                         "median/MAD (thin history falls back to "
+                         "parent per metric)")
+    ap.add_argument("--ledger", default="",
+                    help="ledger directory for --baseline rolling "
+                         "(default: LGBM_TPU_LEDGER or "
+                         "/tmp/lgbm_tpu_ledger)")
+    ap.add_argument("--suite", default="",
+                    help="restrict rolling history to this ledger suite")
+    ap.add_argument("--shape", default="",
+                    help="restrict rolling history to this shape bucket")
+    ap.add_argument("--window", type=int, default=8,
+                    help="rolling-baseline window (last N runs)")
+    ap.add_argument("--min-history", type=int, default=3,
+                    help="comparable runs required before the rolling "
+                         "gate engages (below: parent fallback)")
+    ap.add_argument("--z", type=float, default=3.0,
+                    help="rolling-gate z-score threshold (MAD-based, "
+                         "noise-floored sigma)")
     ap.add_argument("--tol-ips", type=float, default=METRICS[
         "iters_per_sec"][1], help="iters/sec relative tolerance")
     ap.add_argument("--tol-compile", type=float, default=METRICS[
@@ -305,7 +441,16 @@ def main(argv=None):
     except SystemExit2 as e:
         print("error: %s" % e, file=sys.stderr)
         return 2
-    rows = compare(base, cand, tols)
+    modes = {}
+    if args.baseline_mode == "rolling":
+        try:
+            rows, modes = rolling_rows(args, tols, base, cand)
+        except Exception as e:
+            print("error: rolling baseline unavailable: %s" % e,
+                  file=sys.stderr)
+            return 2
+    else:
+        rows = compare(base, cand, tols)
     if not rows:
         print("error: no comparable metrics between %s (%s) and %s (%s)"
               % (args.baseline, sorted(base) or "none",
@@ -315,24 +460,38 @@ def main(argv=None):
     if args.json:
         print(json.dumps({
             "status": "regression" if regressed else "ok",
-            "metrics": [{"metric": n, "baseline": b, "candidate": c,
-                         "delta_frac": round(d, 6), "tolerance": t,
-                         "regressed": r}
+            "mode": args.baseline_mode,
+            "metrics": [dict(
+                {"metric": n, "baseline": b, "candidate": c,
+                 "regressed": r},
+                **({"z": round(t, 3), "delta_frac": round(d, 6),
+                    "gate": "rolling"}
+                   if modes.get(n) == "rolling" else
+                   {"delta_frac": round(d, 6), "tolerance": t,
+                    "gate": modes.get(n, "parent"),
+                    "delta_kind": "abs" if b == 0 else "frac"}))
                         for n, b, c, d, r, t in rows]}))
     else:
         print("%-16s %14s %14s %9s %7s  verdict"
-              % ("metric", "baseline", "candidate", "delta", "tol"))
+              % ("metric", "baseline", "candidate", "delta", "gate"))
         for n, b, c, d, r, t in rows:
-            print("%-16s %14.6g %14.6g %+8.2f%% %6.0f%%  %s"
-                  % (n, b, c, 100 * d, 100 * t,
+            if modes.get(n) == "rolling":
+                gate = "z%+.1f" % t
+            else:
+                gate = "%.0f%%" % (100 * t) if b != 0 else "abs"
+            delta = "%+8.2f%%" % (100 * d) if b != 0 else "%+9.4g" % d
+            print("%-16s %14.6g %14.6g %s %7s  %s"
+                  % (n, b, c, delta, gate,
                      "REGRESSED" if r else "ok"))
         skipped = (set(base) | set(cand)) - {r[0] for r in rows}
         if skipped:
             print("skipped (present in only one artifact): %s"
                   % ", ".join(sorted(skipped)))
     if regressed:
-        print("FAIL: %d metric(s) regressed beyond tolerance"
-              % len(regressed), file=sys.stderr)
+        print("FAIL: %d metric(s) regressed beyond %s"
+              % (len(regressed),
+                 "the rolling noise band" if args.baseline_mode ==
+                 "rolling" else "tolerance"), file=sys.stderr)
         return 1
     return 0
 

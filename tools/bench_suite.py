@@ -267,13 +267,31 @@ def child(name):
     spec = SHAPES[name]
     ds = cached_dataset(name)
     t_load = time.time()
+    # pin the timeline path (bench_modes.run only setdefaults it) so the
+    # measurement can be ingested into the cross-run ledger afterwards
+    obs_path = "/tmp/suite_obs_%s_%d.jsonl" % (name, os.getpid())
+    try:
+        os.unlink(obs_path)
+    except OSError:
+        pass
     # mode=auto + width -1: measure what a DEFAULT user gets at the shape
     dt, metric, g = run(None, None, "auto", wave_width=-1,
                         warmup=spec["warmup"], measured=spec["measured"],
                         extra=dict(spec["params"], tpu_growth="auto",
-                                   verbose=-1),
+                                   verbose=-1, obs_events_path=obs_path),
                         train_set=ds, details=True)
     lrn = g.learner
+    # ledger ingestion is explicit here (the observer belongs to
+    # bench_modes): suite = the shape arm, shape = its nominal size —
+    # best-effort, a ledger problem must not void the measurement
+    try:
+        from lightgbm_tpu.obs.ledger import Ledger, default_ledger_dir
+        if default_ledger_dir():
+            Ledger(default_ledger_dir()).ingest_timeline(
+                obs_path, suite="suite_" + name,
+                shape="%dx%d" % (spec["n"], spec["f"]))
+    except Exception as e:
+        print("suite: ledger ingest failed: %s" % e, file=sys.stderr)
     print(json.dumps({
         "dt": dt, "metric": float(metric),
         "mode": lrn.hist_mode, "growth": lrn.growth,

@@ -218,6 +218,17 @@ NOTES = {
                         "entropy, constant/near-constant/ID-like "
                         "flags, label balance) into a data_profile "
                         "event; findings route through obs_health",
+    "obs_ledger_dir": "cross-run performance ledger: ingest the "
+                      "finished run's metrics into this directory on "
+                      "clean close (empty = off); `obs trend --check` "
+                      "and bench_compare --baseline rolling gate "
+                      "against the accumulated history",
+    "obs_ledger_suite": "ledger suite label — the coarse comparability "
+                        "key rolling baselines group runs by (empty = "
+                        "the run context's tool name)",
+    "obs_ledger_window": "rolling-baseline window: median/MAD "
+                         "statistics cover the last N comparable clean "
+                         "runs of the same (suite, shape, device) cell",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -280,7 +291,8 @@ GROUPS = [
         "obs_metrics_every", "obs_compile", "obs_straggler_every",
         "obs_straggler_warn_skew", "obs_watchdog_secs", "obs_fsync",
         "obs_flight_events", "obs_split_audit", "obs_importance_every",
-        "obs_importance_topk", "obs_data_profile"]),
+        "obs_importance_topk", "obs_data_profile", "obs_ledger_dir",
+        "obs_ledger_suite", "obs_ledger_window"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",

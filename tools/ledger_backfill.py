@@ -1,0 +1,142 @@
+"""Backfill the cross-run ledger from the historical bench artifacts.
+
+The flagship trajectory predates the ledger (obs/ledger.py): rounds
+live as hand-curated ``BENCH_r0*.json`` driver records, builder-witness
+copies under ``bench_artifacts/``, and ``MULTICHIP_r0*.json`` smoke
+outcomes.  This tool folds them into the ledger once so ``python -m
+lightgbm_tpu obs trend`` shows the whole trajectory from day one
+instead of starting blind at the PR that introduced the store.
+
+Synthetic identity: backfilled records get run id ``bench-r0N`` /
+``multichip-r0N`` and header time ``float(N)`` — monotone in round, and
+obviously sub-epoch so the renderers show the round number, not a 1970
+date.  Ingestion is idempotent (the ledger dedups on run id + time), so
+re-running the backfill — or CI re-restoring an old cache — is a no-op.
+
+Mapping:
+
+* ``BENCH_r0N.json`` with a ``parsed`` block -> suite ``flagship``,
+  metrics ``iters_per_sec`` + ``vs_baseline``; a null ``parsed`` (the
+  wedged rounds) is recorded as status ``failed`` with no metrics so
+  the trend's run count reflects the attempt without polluting stats;
+* ``bench_artifacts/BENCH_*.json`` builder copies -> suite ``flagship``
+  too (same cell — they are re-measurements of the same protocol),
+  ``source`` naming the artifact;
+* ``MULTICHIP_r0N.json`` -> suite ``multichip``, metric ``multichip_ok``
+  1.0/0.0 so a future smoke flake shows as a step in the trend.
+
+Usage:  python tools/ledger_backfill.py [--ledger DIR] [--repo DIR]
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.obs.ledger import (Ledger, default_ledger_dir,  # noqa: E402
+                                     LEDGER_REV)
+
+
+def _shape_from_metric(name):
+    """'boosting_iters_per_sec_1Mx28_63leaves_63bins' -> '1Mx28';
+    'boosting_iters_per_sec_higgs10p5Mx28_...' -> 'higgs10p5Mx28'."""
+    m = re.search(r"_([0-9a-zA-Z.]+x[0-9]+)_", str(name))
+    return m.group(1) if m else "-"
+
+
+def _record(run, t, suite, shape, status, metrics, source):
+    return {"rev": LEDGER_REV, "run": run, "t": float(t), "suite": suite,
+            "shape": shape, "device_kind": "tpu", "backend": "tpu",
+            "schema": None, "world_size": 1, "git_rev": "",
+            "git_dirty": False, "host": "", "argv": [],
+            "status": status, "metrics": metrics, "source": source}
+
+
+def bench_records(repo):
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json"))):
+        n = int(re.search(r"r0*(\d+)", os.path.basename(path)).group(1))
+        with open(path) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("value") is not None:
+            metrics = {"iters_per_sec": float(parsed["value"])}
+            if parsed.get("vs_baseline") is not None:
+                metrics["vs_baseline"] = float(parsed["vs_baseline"])
+            out.append(_record(
+                "bench-r%02d" % n, n, "flagship",
+                _shape_from_metric(parsed.get("metric", "")), "ok",
+                metrics, os.path.basename(path)))
+        else:
+            # a wedged round (rc nonzero, nothing parsed): keep the
+            # attempt visible without feeding the rolling stats
+            out.append(_record("bench-r%02d" % n, n, "flagship", "-",
+                               "failed", {"bench_rc": float(doc.get(
+                                   "rc", -1))},
+                               os.path.basename(path)))
+    # builder-witness copies: same protocol, fractionally-offset time so
+    # they sort after the driver record of their round
+    arts = sorted(glob.glob(os.path.join(repo, "bench_artifacts",
+                                         "BENCH_*.json")))
+    for i, path in enumerate(arts):
+        base = os.path.basename(path)
+        m = re.search(r"r0*(\d+)", base)
+        n = int(m.group(1)) if m else 0
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("value") is None:
+            continue
+        metrics = {"iters_per_sec": float(doc["value"])}
+        if doc.get("vs_baseline") is not None:
+            metrics["vs_baseline"] = float(doc["vs_baseline"])
+        out.append(_record(base.replace(".json", ""), n + 0.1 + 0.01 * i,
+                           "flagship",
+                           _shape_from_metric(doc.get("metric", "")),
+                           "ok", metrics, "bench_artifacts/" + base))
+    return out
+
+
+def multichip_records(repo):
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "MULTICHIP_r0*.json"))):
+        n = int(re.search(r"r0*(\d+)", os.path.basename(path)).group(1))
+        with open(path) as f:
+            doc = json.load(f)
+        ok = bool(doc.get("ok"))
+        out.append(_record(
+            "multichip-r%02d" % n, n, "multichip",
+            "%ddev" % int(doc.get("n_devices", 0) or 0),
+            "ok" if ok else "failed",
+            {"multichip_ok": 1.0 if ok else 0.0},
+            os.path.basename(path)))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="backfill the cross-run ledger from BENCH_r0*/"
+                    "MULTICHIP_r0* artifacts (idempotent)")
+    ap.add_argument("--ledger", default="",
+                    help="ledger directory (default: LGBM_TPU_LEDGER or "
+                         "/tmp/lgbm_tpu_ledger)")
+    ap.add_argument("--repo", default=REPO,
+                    help="repository root holding the artifacts")
+    args = ap.parse_args(argv)
+    ledger = Ledger(args.ledger or default_ledger_dir())
+    records = bench_records(args.repo) + multichip_records(args.repo)
+    if not records:
+        print("no BENCH_r0*/MULTICHIP_r0* artifacts under %s" % args.repo)
+        return 1
+    landed = sum(ledger.ingest_record(r) for r in records)
+    print("backfill: %d artifact record(s), %d newly ingested -> %s"
+          % (len(records), landed, ledger.dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
