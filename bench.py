@@ -502,6 +502,11 @@ def dry():
     assert len(iter_recs) == 5, "expected 5 iter records, got %d" \
         % len(iter_recs)
     assert all(e["time_s"] > 0 and e["fenced"] for e in iter_recs)
+    # schema 11: every iter record carries the host-glue seconds between
+    # device program submissions (obs/timers.py OrchestrationClock)
+    assert all(e.get("host_orchestration_s", -1.0) >= 0.0
+               for e in iter_recs), \
+        "iter records missing host_orchestration_s: %r" % iter_recs
     health = [e for e in evs if e["ev"] == "health"]
     bad = [e for e in health if e["status"] != "ok"]
     assert not bad, "healthy dry run emitted non-ok health events: %r" % bad
@@ -566,6 +571,63 @@ def dry():
     finally:
         shutil.rmtree(out, ignore_errors=True)
 
+    # zero mid-tree host syncs on a DEFAULT run: every deliberate
+    # block_until_ready in the training stack routes through
+    # obs/timers.fence, so its counter is a complete audit — with the
+    # NULL observer and no autotune probe the boosting loop must leave
+    # it untouched (the async-dispatch contract the fused iteration and
+    # the staged fast path both rely on).  The periodic stop-check sync
+    # uses jax.device_get and only fires every 16 iters; the warmup
+    # update below burns iteration 0 so the audited window is clean.
+    from lightgbm_tpu.obs import timers as obs_timers
+    bst_def = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                                  "max_bin": 15, "verbose": -1},
+                          train_set=lgb.Dataset(X, label=y))
+    bst_def.update()                    # compile outside the audit
+    fences0 = obs_timers.fence_count()
+    for _ in range(3):
+        bst_def.update()
+    assert obs_timers.fence_count() == fences0, \
+        "default run issued %d mid-tree host sync(s) — the boosting " \
+        "loop must stay fence-free without obs timing" \
+        % (obs_timers.fence_count() - fences0)
+
+    # fused iteration (ops/fused_iter.py): forcing the single-entry
+    # program on CPU must reproduce the staged model bit-for-bit and
+    # still stamp host_orchestration_s on its timeline
+    obs_path_f = obs_path + ".fused"
+    try:
+        os.unlink(obs_path_f)
+    except OSError:
+        pass
+    staged_model = bst.model_to_string()
+    fused_params = dict(params)
+    fused_params.update({"tpu_fused_iter": "on",
+                         "obs_events_path": obs_path_f,
+                         "obs_health": "off", "obs_split_audit": False,
+                         "obs_importance_every": 0,
+                         "obs_ledger_dir": ""})
+    base_params = dict(fused_params)
+    base_params["tpu_fused_iter"] = "off"
+    base_params["obs_events_path"] = ""
+    bst_f = lgb.train(fused_params, lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    bst_s = lgb.train(base_params, lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    assert bst_f._gbdt._fused_state[0] is not None, \
+        "tpu_fused_iter=on did not resolve to the fused program"
+    assert bst_f.model_to_string() == bst_s.model_to_string(), \
+        "fused iteration diverged from the staged chain"
+    del staged_model
+    evs_f = read_events(obs_path_f)
+    fused_iters = [e for e in evs_f if e["ev"] == "iter"]
+    assert fused_iters and all(
+        e.get("host_orchestration_s", -1.0) >= 0.0 for e in fused_iters), \
+        "fused run timeline missing host_orchestration_s"
+    assert any(e["ev"] == "compile" and e.get("entry") == "fused_iter"
+               for e in evs_f), \
+        "fused run never compiled the fused_iter entry"
+
     # cross-run ledger (obs/ledger.py): the clean close above must have
     # ingested this run, and repeated --dry runs accumulate history —
     # the instrument `obs trend --check` and --baseline rolling gate on
@@ -590,6 +652,8 @@ def dry():
                       "compile_attr": len(attr),
                       "autotune_decisions": len(decs),
                       "dataset_construct": len(cons),
+                      "fused_iters": len(fused_iters),
+                      "mid_tree_syncs": 0,
                       "path": obs_path}))
 
 

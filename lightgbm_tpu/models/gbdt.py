@@ -36,6 +36,7 @@ import numpy as np
 from ..io.dataset import TrainingData
 from ..metrics import Metric
 from ..obs import NULL_OBSERVER, observer_from_config
+from ..obs.timers import OrchestrationClock
 from ..objectives import ObjectiveFunction, load_objective_from_string
 from ..ops.learner import SerialTreeLearner, materialize_tree
 from ..ops import predict as dev_predict
@@ -45,6 +46,25 @@ from ..utils.log import Log
 from .tree import Tree
 
 kEpsilon = 1e-15
+
+
+class _NullOrchestration:
+    """No-op stand-in for OrchestrationClock when telemetry is off — the
+    disabled hot path must not construct obs objects (the allocation
+    guard in tests/test_obs.py)."""
+    __slots__ = ()
+
+    def enter(self):
+        pass
+
+    def exit(self):
+        pass
+
+    def host_seconds(self):
+        return 0.0
+
+
+_NULL_ORCH = _NullOrchestration()
 
 
 class GBDT:
@@ -86,6 +106,10 @@ class GBDT:
         self._score_host: Optional[np.ndarray] = None
         self._obs = NULL_OBSERVER
         self._metrics = None
+        # lazily-resolved fused iteration (ops/fused_iter.py): None =
+        # unresolved; (obj_or_None,) = resolved.  Invalidated whenever
+        # the learner / objective / observer it binds is rebuilt.
+        self._fused_state = None
         self.num_tree_per_iteration = 1
         if objective is not None:
             self.num_tree_per_iteration = objective.num_tree_per_iteration()
@@ -231,6 +255,8 @@ class GBDT:
         # re-attach the run observer to the rebuilt learner so entry-point
         # timing survives a reset_parameter callback
         self.learner.set_observer(self._obs)
+        # the fused iteration binds the OLD learner's grow closure
+        self._fused_state = None
         # bagging state (gbdt.cpp ResetBaggingConfig, :134-160)
         self.bag_data_cnt = self.num_data
         self.row_mult = None
@@ -255,6 +281,8 @@ class GBDT:
         self.score_dtype = self.learner.dtype
         self._resolve_score_engine(config)
         self._reset_observer(config)
+        # new learner + objective + observer: re-resolve the fused program
+        self._fused_state = None
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
@@ -452,6 +480,49 @@ class GBDT:
             Log.debug("Re-bagging, using %d data to train", self.bag_data_cnt)
 
     # ------------------------------------------------------------- iteration
+    def _resolve_fused_iter(self):
+        """Resolve ``tpu_fused_iter`` (auto/on/off) to a built
+        FusedIteration, or None for the staged chain.  Resolved once and
+        cached — the verdict depends only on booster/learner/objective
+        shape, all of which invalidate ``_fused_state`` when rebuilt.
+
+        auto: fuse when eligible AND the win is expected — the TPU
+        Pallas wave path is live (dispatch latency is what the fused
+        program removes) or the autotuner measured the fused cell as
+        this shape bucket's winner.  on: force when eligible; an
+        explicit opt-in is never dropped silently, so ineligibility
+        warns.  off: never."""
+        if self._fused_state is not None:
+            return self._fused_state[0]
+        mode = str(getattr(self.config, "tpu_fused_iter", "auto")
+                   or "auto").strip().lower()
+        if mode not in ("auto", "on", "off"):
+            Log.fatal("Unknown tpu_fused_iter %s (expected auto/on/off)",
+                      self.config.tpu_fused_iter)
+        fused = None
+        if mode != "off":
+            from ..ops import fused_iter as _fi
+            ok, why = _fi.fused_supported(self)
+            if not ok:
+                if mode == "on":
+                    Log.warning("tpu_fused_iter=on but the fused iteration "
+                                "is unavailable (%s); using the staged "
+                                "chain", why)
+            else:
+                want = mode == "on"
+                if mode == "auto":
+                    from ..ops.wave import pallas_wave_active
+                    lrn = self.learner
+                    want = (pallas_wave_active(
+                        getattr(lrn, "hist_mode", ""), lrn.dtype)
+                        or bool(getattr(lrn, "fused_autotune", False)))
+                if want:
+                    fused = _fi.FusedIteration.build(
+                        self.learner, self.objective.get_gradients,
+                        self.num_data, self.score_dtype)
+        self._fused_state = (fused,)
+        return fused
+
     def train_one_iter(self, gradients=None, hessians=None,
                        is_eval: bool = True) -> bool:
         """GBDT::TrainOneIter (gbdt.cpp:339-458); returns True to stop."""
@@ -460,6 +531,12 @@ class GBDT:
         obs = self._obs
         it0 = self.iter
         obs.iter_begin(it0)
+        # host-orchestration accounting (obs/timers.py): everything this
+        # method does OUTSIDE the enter()/exit()-bracketed device
+        # dispatches is per-iteration host glue — emitted as the
+        # schema-11 ``host_orchestration_s`` iter field, the quantity
+        # the fused iteration exists to drive to ~0
+        oc = OrchestrationClock() if obs.enabled else _NULL_ORCH
         # split-audit needs to know which models this iteration appends
         # (includes the iteration-0 boost_from_average stub, which the
         # audit emitter skips — a stub has no realized split to record)
@@ -485,11 +562,26 @@ class GBDT:
             self.boost_from_average_used = True
 
         custom = gradients is not None and hessians is not None
-        if not custom:
+        # fused iteration (ops/fused_iter.py): gradients + grow + score
+        # update submitted as ONE device entry per tree.  Per-call custom
+        # gradients force the staged chain — they are host arrays the
+        # fused program cannot see.
+        fused = None if custom else self._resolve_fused_iter()
+        g_dev = h_dev = None
+        if fused is not None:
+            # no host gradient section at all: the bag multiplier is the
+            # only host-side training input the fused program takes
+            # (eligibility excludes the GOSS rescale, so plain _bagging
+            # is exactly what _bagging_with_grad would have done)
+            self._bagging(self.iter)
+            obs.lap("boost")
+        elif not custom:
             if self.objective is None:
                 Log.fatal("No object function provided")
+            oc.enter()
             g_dev, h_dev = self.objective.get_gradients(
                 self._score_for_objective())
+            oc.exit()
             g_dev = jnp.reshape(g_dev, (k, self.num_data))
             h_dev = jnp.reshape(h_dev, (k, self.num_data))
             gradients = hessians = None
@@ -499,10 +591,12 @@ class GBDT:
             g_dev = jnp.asarray(gradients)
             h_dev = jnp.asarray(hessians)
 
-        # bagging / GOSS may need host gradients and may rescale them
-        g_dev, h_dev = self._bagging_with_grad(self.iter, g_dev, h_dev)
-        # "boost" = objective gradients + bagging (+ first-iter stub tree)
-        obs.lap("boost", (g_dev, h_dev))
+        if fused is None:
+            # bagging / GOSS may need host gradients and may rescale them
+            g_dev, h_dev = self._bagging_with_grad(self.iter, g_dev, h_dev)
+            # "boost" = objective gradients + bagging (+ first-iter stub
+            # tree)
+            obs.lap("boost", (g_dev, h_dev))
 
         # health monitors (obs/health.py): dispatch the finiteness /
         # magnitude reductions async now, verdicts in one sync below
@@ -516,24 +610,45 @@ class GBDT:
         last_leaf_id = None
         for tid in range(k):
             if self.class_need_train[tid]:
-                dev_tree, leaf_id = self.learner.train_device(g_dev[tid],
-                                                              h_dev[tid],
-                                                              self.row_mult)
-                last_leaf_id = leaf_id
-                # "grow" = the fused histogram+split+partition XLA program
-                # (one jitted entry; finer decomposition needs a profiler
-                # window — see docs/Observability.md)
-                obs.lap("grow", leaf_id)
-                # device score updates (train via partition, valids via
-                # traversal) — all async
-                self._score_dev = self._score_dev.at[tid].set(
-                    dev_predict.update_score_from_partition(
-                        self._score_dev[tid], leaf_id,
-                        dev_tree.leaf_value,
-                        jnp.asarray(self.shrinkage_rate, self.score_dtype),
-                        engine=self._score_engine))
-                self._invalidate_train()
-                obs.lap("partition", self._score_dev)
+                if fused is not None:
+                    # one dispatch: gradients, the grow while_loop and
+                    # the partition score update never return to host
+                    # (bit-identical to the staged chain below —
+                    # tests/test_fused_iter.py)
+                    oc.enter()
+                    dev_tree, leaf_id, new_score = fused.run(
+                        self._score_dev[tid], self.row_mult, None,
+                        jnp.asarray(self.shrinkage_rate, self.score_dtype))
+                    obs.lap("grow", leaf_id)
+                    self._score_dev = self._score_dev.at[tid].set(new_score)
+                    self._invalidate_train()
+                    obs.lap("partition", self._score_dev)
+                    oc.exit()
+                    last_leaf_id = leaf_id
+                else:
+                    oc.enter()
+                    dev_tree, leaf_id = self.learner.train_device(
+                        g_dev[tid], h_dev[tid], self.row_mult)
+                    # "grow" = the histogram+split+partition XLA program
+                    # (one jitted entry; finer decomposition needs a
+                    # profiler window — see docs/Observability.md)
+                    obs.lap("grow", leaf_id)
+                    oc.exit()
+                    last_leaf_id = leaf_id
+                    # device score updates (train via partition, valids
+                    # via traversal) — all async
+                    oc.enter()
+                    self._score_dev = self._score_dev.at[tid].set(
+                        dev_predict.update_score_from_partition(
+                            self._score_dev[tid], leaf_id,
+                            dev_tree.leaf_value,
+                            jnp.asarray(self.shrinkage_rate,
+                                        self.score_dtype),
+                            engine=self._score_engine))
+                    self._invalidate_train()
+                    obs.lap("partition", self._score_dev)
+                    oc.exit()
+                oc.enter()
                 ta = dev_predict.traversal_from_grow(dev_tree)
                 scaled = ta._replace(leaf_value=ta.leaf_value)
                 for vi in range(len(self.valid_data)):
@@ -547,6 +662,7 @@ class GBDT:
                     self._invalidate_valid(vi)
                 if self.valid_data:
                     obs.lap("update", self._valid_score_dev[-1])
+                oc.exit()
                 self.models.append(None)
                 self._models_dev.append(dev_tree)
                 self._models_shrink.append(self.shrinkage_rate)
@@ -570,6 +686,11 @@ class GBDT:
                                 jnp.asarray(out, self.score_dtype))
                         self._invalidate_valid(vi)
                 self._append_host_tree(tree)
+
+        # snapshot BEFORE the opt-in sync work below (health verdicts,
+        # eval, model obs): host_orchestration_s is the per-tree
+        # submission glue, not the explicitly-priced sync features
+        host_orch = oc.host_seconds()
 
         if health_leaves is not None:
             # one batched device_get over the staged scalars; may raise
@@ -596,16 +717,19 @@ class GBDT:
             should_continue = False
         if not should_continue:
             self._pop_degenerate_iterations()
-            obs.iter_end(it0, value=self._score_dev, stopped=True)
+            obs.iter_end(it0, value=self._score_dev, stopped=True,
+                         host_orchestration_s=host_orch)
             return True
         self.iter += 1
         self._emit_model_obs(it0, start_models)
         if is_eval:
             stop = self.eval_and_check_early_stopping()
             obs.lap("eval")
-            obs.iter_end(it0, value=self._score_dev)
+            obs.iter_end(it0, value=self._score_dev,
+                         host_orchestration_s=host_orch)
             return stop
-        obs.iter_end(it0, value=self._score_dev)
+        obs.iter_end(it0, value=self._score_dev,
+                     host_orchestration_s=host_orch)
         return False
 
     def _emit_model_obs(self, it0: int, start_models: int) -> None:

@@ -100,13 +100,18 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
-# 7 (no autotune/band-escape events), 8 (no dataset_construct) and
-# 9 (no run_header provenance) timelines still parse
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+# 7 (no autotune/band-escape events), 8 (no dataset_construct),
+# 9 (no run_header provenance) and 10 (no host_orchestration_s iter
+# field — schema 11 adds the host-glue seconds between device program
+# submissions, models/gbdt.py OrchestrationClock) timelines still parse.
+# wave_band_escape stays accepted for old timelines even though nothing
+# emits it anymore (the band prior died in PR-11; ops/pallas_wave.py
+# tile planner post-mortem).
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {

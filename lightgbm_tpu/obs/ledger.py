@@ -64,6 +64,7 @@ METRIC_DIRECTIONS = {
     "serve_p99_s": -1,
     "serve_shed_rate": -1,
     "autotune_overhead_s": -1,
+    "host_orchestration_s": -1,
     "construct_s": -1,
     "vs_baseline": +1,
     "multichip_ok": +1,
@@ -95,6 +96,12 @@ def metrics_from_events(events):
     total = sum(float(e.get("time_s", 0.0)) for e in iters)
     if iters and total > 0:
         out["iters_per_sec"] = len(iters) / total
+    # schema 11: host glue between device program submissions (mean per
+    # iteration) — the series that attributes a fused-iteration win
+    orch = [float(e["host_orchestration_s"]) for e in iters
+            if "host_orchestration_s" in e]
+    if orch:
+        out["host_orchestration_s"] = sum(orch) / len(orch)
     run_end = next((e for e in events if e.get("ev") == "run_end"), None)
     entries = (run_end or {}).get("entries") or {}
     if entries:

@@ -12,6 +12,18 @@ from __future__ import annotations
 
 import time
 
+# process-global count of host<->device synchronizations issued through
+# fence().  Every deliberate block_until_ready in the training stack
+# routes through fence() so this is a complete audit: a default run
+# (NULL observer, no autotune probe) must leave it unchanged across
+# training — asserted by bench.py --dry.
+_FENCE_COUNT = 0
+
+
+def fence_count() -> int:
+    """Total fence() calls that reached block_until_ready (sync audit)."""
+    return _FENCE_COUNT
+
 
 def fence(value):
     """Block until ``value`` (array / pytree / None) is device-complete.
@@ -20,10 +32,12 @@ def fence(value):
     scalars, numpy arrays) pass through untouched, so call sites can hand
     over whatever the phase produced without type checks.
     """
+    global _FENCE_COUNT
     if value is None:
         return
     try:
         import jax
+        _FENCE_COUNT += 1
         jax.block_until_ready(value)
     except Exception:       # non-jax value, or backend already torn down
         pass
@@ -121,3 +135,35 @@ class EntryTimers:
                                       else 0.0)),
             }
         return out
+
+
+class OrchestrationClock:
+    """Host time BETWEEN device program submissions within one iteration.
+
+    Construction marks the iteration start; ``enter()``/``exit()``
+    bracket each device-entry dispatch (the jitted call itself, which is
+    asynchronous — its wall time is queueing, not orchestration); the
+    remainder is the host's own per-iteration glue: gradient reshapes,
+    padding, ``.at[].set`` staging, bookkeeping Python.  That remainder
+    is the ``host_orchestration_s`` field on the schema-11 ``iter``
+    event — the quantity the fused iteration (ops/fused_iter.py) is
+    built to drive to ~0.  Never fences: measuring must not perturb the
+    async pipeline.
+    """
+
+    __slots__ = ("_t0", "_t_enter", "_inside")
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._t_enter = 0.0
+        self._inside = 0.0
+
+    def enter(self):
+        self._t_enter = time.perf_counter()
+
+    def exit(self):
+        self._inside += time.perf_counter() - self._t_enter
+
+    def host_seconds(self) -> float:
+        """Elapsed since construction minus time spent inside dispatches."""
+        return max(0.0, (time.perf_counter() - self._t0) - self._inside)

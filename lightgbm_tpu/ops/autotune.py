@@ -7,8 +7,8 @@ bound (``ncols * bin_pad <= 2560``), the W-ladder cap at 32, and a deck
 of pre-registered but never-applied promotion rules (BENCH_NOTES.md
 "Armed decks").  This module inverts that architecture: selection is a
 single decision function (`decide`) that treats the old heuristics as
-the *prior*, enumerates the 3-5 viable (hist_kernel, wave_width,
-precision, compaction) cells for the actual shape, microbenches each
+the *prior*, enumerates the 3-6 viable (hist_kernel, wave_width,
+precision, compaction, fused-iteration) cells for the actual shape, microbenches each
 cell for a few waves on the real device with real-shaped data, picks
 the winner, and persists it in an on-disk cache keyed by
 (shape-bucket, device-kind, schema rev) next to the XLA compile cache
@@ -66,21 +66,15 @@ from .wave import WAVE_ONLY_MODES, hist_block_bytes
 # beyond it are not enumerated, they would not compile.
 WAVE_VMEM_GATE = 64 << 20
 
-# Mid-size accumulator-block pathology, measured on v5e (BENCH_NOTES.md,
-# r4): hist blocks of ~17-25 MB run 10-43x slower than the same shape
-# one width tier up (~34-49 MB) — epsilon forced-W16 19.1 s/iter vs W32
-# 0.45; bosch dense W32 9.75 vs W64 0.90; yahoo's 2.1x headline sits at
-# a 17 MB W32 cell.  Root cause unconfirmed (suspect: Mosaic scheduling
-# of mid-size out blocks, ops/pallas_wave.py::_tile_plan); until a trace
-# lands, auto widths BUMP OUT of the band when the escaped block still
-# compiles.  Bounds are deliberately wide of the measured cells.
-# Round-5 narrowing (pre-registered rule, BENCH_NOTES.md "Armed
-# decks"): yahoo's 17.2 MB W=32 cell escaped to W=64 under the original
-# (12 MB, 30 MB) band and measured 3.2x SLOWER (22.5 vs 7.06 s/iter,
-# tools/BENCH_SUITE.md yahoo_w64) — so the lower bound moves past it.
-# Bosch's 23.8 MB W=32 cell (the data-backed escape: W=64 was 10.8x
-# faster) stays inside.
-HIST_BLOCK_BAND = (18 << 20, 30 << 20)
+# The 18-30 MB mid-size accumulator-block pathology band and its
+# `band_adjusted_width` escape prior were DELETED in v11: the root cause
+# was the wave kernels' row-tile planner sizing input tiles against a
+# fixed 16 MB budget that ignored the VMEM-resident accumulator block,
+# so exactly the mid-band blocks oversubscribed VMEM under Mosaic
+# double-buffering and spilled.  The planner now subtracts the resident
+# block from the tile budget (ops/pallas_wave.py::_tile_plan; regression
+# probe `tile_plan_vmem_report`), so in-band cells are ordinary measured
+# candidates — see docs/FusedIteration.md for the post-mortem.
 
 # the measured pallas_ct promotion bound (ncols * bin_pad) — a PRIOR
 # heuristic, not a hard gate: in measure mode ct cells beyond it are
@@ -90,13 +84,20 @@ CT_PROMOTION_BOUND = 2560
 
 # bump when the meaning of a cached cell changes (new tuned dimension,
 # changed probe workload, kernel semantics change): old entries carry
-# the old rev in their key and simply stop matching
-CACHE_SCHEMA_REV = 1
+# the old rev in their key and simply stop matching, and `load_cache`
+# drops whole files written at another rev so stale entries can never
+# be re-merged into a new-rev file by `store_cache`.
+# rev 2: cells gained the `fused` dimension (ops/fused_iter.py) and the
+# wave kernels' tile plan changed (accumulator-aware budget) — rev-1
+# timings measured the old plan and do not transfer.
+CACHE_SCHEMA_REV = 2
 
-# enumeration cap — a probe costs a compile + a few waves, and past ~5
-# cells the marginal candidate is a long shot (the prior and its four
-# single-step neighbours cover the measured surprises)
-MAX_CELLS = 5
+# enumeration cap — a probe costs a compile + a few waves, and past ~6
+# cells the marginal candidate is a long shot (the prior and its
+# single-step neighbours cover the measured surprises).  Raised 5 -> 6
+# at rev 2 so the fused-iteration flip fits alongside the original four
+# neighbour arms.
+MAX_CELLS = 6
 
 _CACHE_ENV = "LGBM_TPU_COMPILE_CACHE"
 _CACHE_DEFAULT_DIR = "/tmp/lgbm_tpu_xla_cache"
@@ -160,32 +161,6 @@ def resolve_wave_width(config: Config, num_leaves: int,
     if num_leaves <= 127:
         return 16
     return 32
-
-
-def band_adjusted_width(width: int, ncols: int, bin_pad: int) -> int:
-    """Auto-width escape from the pathological hist-block band: move W
-    up (doubling, capped at 64) to the FIRST width whose accumulator
-    block lands strictly past the band's upper edge while still inside
-    the kernels' VMEM gate.  If no doubling clears the band — the cap
-    or the VMEM gate stops the escape while the block is still inside
-    it — the ORIGINAL width is kept: an escape that stops at an
-    unmeasured in-band cell would trade a measured pathology for an
-    unmeasured one.  Explicit user widths never pass through here, and
-    neither does the order-sensitivity W=1 pin (resolve_wave_width's
-    quality gate for DART/GOSS/lambdarank under batched order) — a
-    speed escape must not undo a quality decision."""
-    if width <= 1:
-        return width
-    lo, hi = HIST_BLOCK_BAND
-    block = hist_block_bytes(ncols, bin_pad, width)
-    if not lo <= block < hi:
-        return width
-    esc, esc_block = width, block
-    while (esc_block < hi and esc < 64
-           and esc_block * 2 <= WAVE_VMEM_GATE):
-        esc *= 2
-        esc_block *= 2
-    return esc if esc_block >= hi else width
 
 
 def prior_hist_mode(config: Config, ncols: int, bin_pad: int,
@@ -298,17 +273,24 @@ class Cell(NamedTuple):
     wave_width: int     # W
     hist_hilo: bool     # True = hi/lo f32 pair, False = single-bf16
     compact: bool       # frontier compaction (tpu_wave_compact)
+    # rev 2: run the whole iteration as one fused device program
+    # (ops/fused_iter.py) instead of the staged gradient/grow/score
+    # entry chain — a measured dimension because fusion trades XLA
+    # scheduling freedom for zero host orchestration
+    fused: bool = False
 
     def as_dict(self) -> dict:
         return {"hist_mode": self.hist_mode,
                 "wave_width": int(self.wave_width),
                 "hist_hilo": bool(self.hist_hilo),
-                "compact": bool(self.compact)}
+                "compact": bool(self.compact),
+                "fused": bool(self.fused)}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Cell":
         return cls(str(d["hist_mode"]), int(d["wave_width"]),
-                   bool(d["hist_hilo"]), bool(d["compact"]))
+                   bool(d["hist_hilo"]), bool(d["compact"]),
+                   bool(d.get("fused", False)))
 
 
 class ShapeBucket(NamedTuple):
@@ -334,6 +316,7 @@ class Pins(NamedTuple):
     width: bool = False
     precision: bool = False
     compact: bool = False
+    fused: bool = False
 
 
 class Decision(NamedTuple):
@@ -383,13 +366,23 @@ def _device_kind() -> str:
 
 def load_cache(path: str) -> dict:
     """Read the cache file; a missing or corrupt file is an empty cache
-    (the tuner must never take training down)."""
+    (the tuner must never take training down).
+
+    A file written at another ``CACHE_SCHEMA_REV`` is ALSO an empty
+    cache: its entries were measured against different cell semantics
+    (and carry old-rev keys), and returning them here would let
+    ``store_cache`` re-merge them — verbatim, pins and all — into a
+    file it then stamps with the new rev, resurrecting stale winners
+    forever.  Dropping the whole file invalidates cleanly; the next
+    measure-mode run re-probes (tests/test_autotune.py)."""
     try:
         with open(path) as f:
             data = json.load(f)
+        if data.get("version") != CACHE_SCHEMA_REV:
+            return {}
         entries = data.get("entries", {})
         return entries if isinstance(entries, dict) else {}
-    except (OSError, ValueError):
+    except (OSError, ValueError, AttributeError):
         return {}
 
 
@@ -424,12 +417,13 @@ def apply_pins(cell: Cell, prior: Cell, pins: Pins) -> Cell:
         hist_mode=prior.hist_mode if pins.kernel else cell.hist_mode,
         wave_width=prior.wave_width if pins.width else cell.wave_width,
         hist_hilo=prior.hist_hilo if pins.precision else cell.hist_hilo,
-        compact=prior.compact if pins.compact else cell.compact)
+        compact=prior.compact if pins.compact else cell.compact,
+        fused=prior.fused if pins.fused else cell.fused)
 
 
 def enumerate_cells(prior: Cell, bucket: ShapeBucket, pins: Pins,
                     ct_allowed: bool = True) -> List[Cell]:
-    """The 3-5 candidate cells: the prior plus its single-step
+    """The 3-6 candidate cells: the prior plus its single-step
     neighbours along each unpinned dimension, hard-gated on VMEM.
 
     Neighbour choices mirror the measured surprises: width one tier up
@@ -445,6 +439,14 @@ def enumerate_cells(prior: Cell, bucket: ShapeBucket, pins: Pins,
         # engines have no neighbours to probe
         return [prior]
     cands: List[Cell] = [prior]
+    if not pins.fused:
+        # the staged/fused flip (rev 2): same kernels, different entry
+        # granularity — measured because fusing removes host gaps but
+        # also removes XLA's freedom to overlap the stages.  Enumerated
+        # FIRST among the neighbours: it is the rev-2 headline dimension
+        # and must not fall off the MAX_CELLS tail when every other
+        # dimension is unpinned too.
+        cands.append(prior._replace(fused=not prior.fused))
     if not pins.width:
         for w in (prior.wave_width * 2, prior.wave_width // 2):
             if 1 <= w <= 64:

@@ -38,7 +38,8 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G, RIGHT_SUM_H,
                            SECOND_FEATURE, SECOND_GAIN, SPLIT_VEC_SIZE,
                            THRESHOLD, FeatureMeta, SplitParams,
-                           find_best_split_impl, per_feature_candidates)
+                           depth_gated_best, find_best_split_impl,
+                           per_feature_candidates)
 
 
 class BundleArrays(NamedTuple):
@@ -373,10 +374,9 @@ def make_grow_core(num_leaves: int, num_bins: int,
         return b
 
     def best_of_serial(hist, sums, feature_mask, depth, meta, bundle):
-        b = find_best_split_impl(to_feature_hist(hist, sums, meta, bundle),
-                                 sums[0], sums[1], sums[2], meta,
-                                 feature_mask, params)
-        return depth_gate(b, depth)
+        return depth_gated_best(to_feature_hist(hist, sums, meta, bundle),
+                                sums, meta, feature_mask, params, max_depth,
+                                depth)
 
     def best_of_feature_parallel(hist, sums, feature_mask, depth,
                                  local_meta, offset):

@@ -85,11 +85,14 @@ def build_bundle_arrays(train_data: TrainingData):
 
 # kernel-selection policy now lives in ops/autotune.py (the measured
 # autotuner's PRIOR); re-exported here because tests and downstream
-# code import the resolvers from the learner module
-from .autotune import (HIST_BLOCK_BAND as _HIST_BLOCK_BAND,
-                       WAVE_VMEM_GATE as _WAVE_VMEM_GATE,
-                       _order_sensitive, band_adjusted_width,
-                       resolve_wave_order, resolve_wave_width)
+# code import the resolvers from the learner module.  (The former
+# HIST_BLOCK_BAND / band_adjusted_width escape prior is gone: the
+# 18-30 MB degeneracy was root-caused to the tile planner's live-set
+# overshoot and fixed in ops/pallas_wave.py _tile_plan — post-mortem
+# in docs/FusedIteration.md.)
+from .autotune import (WAVE_VMEM_GATE as _WAVE_VMEM_GATE,
+                       _order_sensitive, resolve_wave_order,
+                       resolve_wave_width)
 
 
 def build_split_params(config: Config) -> SplitParams:
@@ -285,36 +288,16 @@ class SerialTreeLearner:
         self.wave_width = (resolve_wave_width(config, self.num_leaves,
                                               self.wave_order)
                            if growth == "wave" else 1)
-        if growth == "wave" and int(config.tpu_wave_width) == -1:
-            from .wave import hist_block_bytes
-            from .wave import pallas_wave_active as _pwa
-            if _pwa(self.hist_mode, self.dtype):
-                # escape the measured mid-size accumulator-block
-                # pathology (band_adjusted_width) — auto widths only.
-                # An escape is a silent perf decision no longer: it
-                # warns and lands on the timeline (wave_band_escape,
-                # schema v8) so the pathology band is visible in
-                # telemetry, not only in BENCH_NOTES.md.
-                w0 = self.wave_width
-                self.wave_width = band_adjusted_width(
-                    w0, ncols, _bin_pad(nbins))
-                if self.wave_width != w0:
-                    lo, hi = _HIST_BLOCK_BAND
-                    Log.warning(
-                        "auto wave width escaped the pathological "
-                        "hist-block band: W=%d -> W=%d (block %.1f MB "
-                        "in the measured %d-%d MB slow band, "
-                        "BENCH_NOTES.md)", w0, self.wave_width,
-                        hist_block_bytes(ncols, _bin_pad(nbins), w0)
-                        / (1 << 20), lo >> 20, hi >> 20)
-                    self._pending_events.append(("wave_band_escape", {
-                        "width_from": int(w0),
-                        "width_to": int(self.wave_width),
-                        "block_mb": round(hist_block_bytes(
-                            ncols, _bin_pad(nbins), w0) / (1 << 20), 2),
-                        "band_lo_mb": lo >> 20, "band_hi_mb": hi >> 20,
-                        "ncols": int(ncols),
-                        "bin_pad": int(_bin_pad(nbins))}))
+        # NOTE (PR 11): auto widths are no longer bent away from the
+        # 18-30 MB accumulator-block band.  The band was a lossy proxy
+        # for the tile planner's live-set overshoot of Mosaic's overlap
+        # window; ops/pallas_wave.py _tile_plan now budgets the row tile
+        # against the resident accumulator directly, so in-band widths
+        # are no longer pathological (tile_plan_vmem_report is the
+        # probe; regression-pinned in tests/test_pallas_wave.py and
+        # tests/test_fused_iter.py, post-mortem in
+        # docs/FusedIteration.md).  Old timelines may still carry
+        # wave_band_escape events; the schema keeps accepting them.
         if bool(config.tpu_wave_compact):
             from .wave import pallas_wave_active as _pwa2
             if not (growth == "wave"
@@ -518,6 +501,18 @@ class SerialTreeLearner:
         else:
             self._ones = jnp.ones(train_data.num_data, self.dtype)
         self._full_mask = jnp.ones(max(train_data.num_features, 1), dtype=bool)
+        # CPU-interpret Pallas execution (tests / CI parity runs): a
+        # forced wave-kernel mode off-TPU normally falls back to the XLA
+        # wave path; tpu_pallas_interpret=true runs the ACTUAL Pallas
+        # kernels through the interpreter instead, so fused-vs-staged
+        # bit-identity and the tile-plan regressions are CPU-testable
+        # end-to-end (tests/test_fused_iter.py, CI bench-smoke).  On TPU
+        # the flag is meaningless — the compiled kernels run.
+        self.pallas_interpret = bool(config.tpu_pallas_interpret)
+        if self.pallas_interpret and jax.default_backend() == "tpu":
+            Log.warning("tpu_pallas_interpret=true ignored on TPU (the "
+                        "compiled Pallas kernels run)")
+            self.pallas_interpret = False
         # ---- measured kernel autotune (ops/autotune.py).  Everything
         # resolved above — hist_mode, wave_width, hist_hilo,
         # wave_compact — is the heuristic PRIOR; under
@@ -532,7 +527,8 @@ class SerialTreeLearner:
                                    int(self.num_leaves),
                                    _at.row_bucket(train_data.num_data))
         at_prior = _at.Cell(self.hist_mode, int(self.wave_width),
-                            bool(self.hist_hilo), self.wave_compact)
+                            bool(self.hist_hilo), self.wave_compact,
+                            fused=False)
         at_pins = _at.Pins(
             # pins = explicit user choices + quality gates, never tuned
             kernel=str(config.tpu_histogram_mode) != "auto",
@@ -540,7 +536,11 @@ class SerialTreeLearner:
                    or (_order_sensitive(config)
                        and self.wave_order != "exact")),
             precision=hp != "auto",
-            compact="tpu_wave_compact" in config.raw)
+            compact="tpu_wave_compact" in config.raw,
+            # an explicit tpu_fused_iter=on/off is a user decision the
+            # tuner must not second-guess; auto leaves the staged/fused
+            # flip a measured dimension (rev-2 cells)
+            fused=str(config.tpu_fused_iter).strip().lower() != "auto")
         at_eligible = (growth == "wave" and psum_axis is None
                        and not sparse_on and self.dtype == jnp.float32
                        and self.hist_mode in WAVE_ONLY_MODES)
@@ -551,6 +551,10 @@ class SerialTreeLearner:
                          ct_allowed=psum_axis is None)
         self.autotune_mode, self.autotune_source = dec.mode, dec.source
         self._pending_events.extend(dec.events)
+        # measured staged-vs-fused verdict for this shape bucket; the
+        # booster's tpu_fused_iter=auto resolution consults it
+        # (models/gbdt.py _resolve_fused_iter)
+        self.fused_autotune = bool(dec.cell.fused)
         if dec.cell != at_prior:
             self.hist_mode = hist_mode = dec.cell.hist_mode
             self.wave_width = int(dec.cell.wave_width)
@@ -579,7 +583,7 @@ class SerialTreeLearner:
                 int(config.tpu_wave_chunk), self.packed_cols,
                 self.sparse_col_cap, self.wave_order == "exact",
                 self.wave_lookup, self.hist_hilo,
-                self.wave_compact)
+                self.wave_compact, self.pallas_interpret)
             meta, bund = self.meta, self.bundle_arrays
             # the transposed kernel's (F, N) matrix: materialized ONCE per
             # booster (X never changes across trees), not per dispatch;
@@ -647,8 +651,18 @@ class SerialTreeLearner:
         uploaded bin matrix with synthetic deterministic gradients, and
         returns a nullary run closure the tuner times.  make_wave_jit
         is lru-cached, so the winning cell's probe compile is reused by
-        the production core."""
+        the production core.
+
+        ``cell.fused`` flips the probe between the two iteration
+        dataflows the booster can submit (models/gbdt.py): the staged
+        chain times gradients / grow / score-update as separate
+        dispatches (host glue between them included in what the timer
+        sees), the fused chain times the whole step as ONE jitted entry
+        — the exact shape ops/fused_iter.py compiles — so the
+        staged-vs-fused flip is genuinely measured, not guessed."""
         from .wave import make_wave_jit, transposed_wave_active
+        from .partition import score_update_impl
+        from ..obs.timers import fence
 
         def probe(cell):
             core = make_wave_jit(
@@ -659,22 +673,54 @@ class SerialTreeLearner:
                 int(config.tpu_wave_chunk), self.packed_cols,
                 self.sparse_col_cap, self.wave_order == "exact",
                 self.wave_lookup, bool(cell.hist_hilo),
-                bool(cell.compact))
+                bool(cell.compact), self.pallas_interpret)
             xt = (jnp.transpose(self.X)
                   if transposed_wave_active(cell.hist_mode, self.dtype)
                   else None)
             n = int(self._ones.shape[0])
-            # deterministic, real-shaped probe inputs: a sign-varying
-            # gradient so splits have gain and the wave actually sweeps
-            g = jnp.asarray(np.linspace(-1.0, 1.0, n), self.dtype)
-            h = jnp.full((n,), 0.25, self.dtype)
             rm, mask = self._ones, self._full_mask
             meta, bund = self.meta, self.bundle_arrays
+            # deterministic, real-shaped iteration state: an L2-style
+            # in-graph gradient from the running score against a
+            # sign-varying target, so splits have gain and the wave
+            # actually sweeps
+            tgt = jnp.asarray(np.linspace(-1.0, 1.0, n), self.dtype)
+            score0 = jnp.zeros((n,), self.dtype)
+            scale = jnp.asarray(0.1, self.dtype)
 
-            def run():
-                tree, leaf_id = core(self.X, g, h, rm, mask, meta,
-                                     bund, Xt=xt)
-                jax.block_until_ready(leaf_id)
+            def _grad(score):
+                return score - tgt, jnp.full((n,), 0.25, self.dtype)
+
+            if cell.fused:
+                def _step(score):
+                    g, h = _grad(score)
+                    tree, leaf_id = core(self.X, g, h, rm, mask, meta,
+                                         bund, Xt=xt)
+                    return score_update_impl(score, leaf_id,
+                                             tree.leaf_value, scale)
+
+                step = jax.jit(_step)
+
+                def run():
+                    # measurement-scoped sync: the tuner needs the wall
+                    # time of the finished program.  Production
+                    # iterations never block mid-tree (bench.py --dry
+                    # asserts a zero fence-count delta); every probe
+                    # sync goes through obs/timers.fence so that audit
+                    # has a single counted choke point.
+                    fence(step(score0))
+            else:
+                grad_fn = jax.jit(_grad)
+                upd = jax.jit(score_update_impl)
+
+                def run():
+                    # the staged chain the booster submits: three
+                    # separate dispatches with the host glue between
+                    # them inside the timed window
+                    g, h = grad_fn(score0)
+                    tree, leaf_id = core(self.X, g, h, rm, mask, meta,
+                                         bund, Xt=xt)
+                    fence(upd(score0, leaf_id, tree.leaf_value, scale))
 
             return run
 
@@ -705,6 +751,9 @@ class SerialTreeLearner:
             "wave_compact": bool(getattr(self, "wave_compact", False)),
             "autotune_mode": getattr(self, "autotune_mode", "off"),
             "autotune_source": getattr(self, "autotune_source", ""),
+            "fused": bool(getattr(self, "fused_autotune", False)),
+            "pallas_interpret": bool(getattr(self, "pallas_interpret",
+                                             False)),
             "packed_cols": int(getattr(self, "packed_cols", 0) or 0),
             "num_leaves": int(self.num_leaves),
             "num_bins": int(self.num_bins),

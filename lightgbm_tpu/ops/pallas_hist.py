@@ -55,12 +55,22 @@ def tile_shape(num_bins: int):
     under the ~16MB VMEM budget.  F_BLK stays at 8 (the TPU sublane
     minimum for f32 blocks); large-B kernels shrink the row chunk.
 
+    The budget is accounted against the kernel's LIVE SET, not the
+    one-hot tile alone — the wave-kernel band post-mortem
+    (ops/pallas_wave.py::_tile_plan, docs/FusedIteration.md) showed that
+    ignoring resident blocks is exactly how mid-size shapes silently
+    oversubscribe VMEM.  Here the resident (F_BLK, B, 3) f32 accumulator
+    is bounded (F_BLK is fixed at 8), so it is subtracted from the tile
+    budget rather than driving a separate regime.
+
     Public: the kernel's VMEM geometry is part of the selection surface
     the autotuner (ops/autotune.py) and its probe harness reason about
     when instantiating kernel cells standalone."""
     f_blk = 8
     row_chunk = 2048
-    while f_blk * num_bins * row_chunk * 4 > 6 * 2**20 and row_chunk > 512:
+    resident = f_blk * num_bins * 3 * 4          # the out block, VMEM-held
+    budget = 6 * 2**20 - resident
+    while f_blk * num_bins * row_chunk * 4 > budget and row_chunk > 512:
         row_chunk //= 2
     return f_blk, row_chunk
 

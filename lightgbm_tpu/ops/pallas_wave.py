@@ -34,17 +34,59 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernels run (compiled or interpreted) across the jax versions we see
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 from .wave import WAVE_ONLY_MODES, _bin_pad  # noqa: F401  (shared policy
 # lives in wave.py, which stays importable without jax.experimental.pallas)
 
 
-def _tile_plan(n, fc, bp, row_tile):
+# -- VMEM scheduling thresholds (the 18-30 MB band post-mortem) ----------
+# The former "pathology band" (deleted HIST_BLOCK_BAND prior,
+# ops/autotune.py) was a lossy proxy for a Mosaic scheduling edge the
+# fused-iteration probe work finally isolated: the accumulator block's
+# per-sub-block read-modify-write only overlaps the MXU contraction while
+# the kernel's LIVE SET (resident accumulator + transient tiles) fits the
+# ~52 MB overlap window; past it Mosaic serializes the accumulate-store
+# against the next dot — UNLESS the accumulator alone is big enough
+# (~44 MB) that the chunked-RMW schedule takes over, which overlaps
+# regardless.  That is why the degeneracy looked like a band: small
+# blocks fit, huge blocks went chunked, and only the middle serialized —
+# and why the band misfired on yahoo's W=64 cell (34 MB resident + 33 MB
+# transients: over the window, below the chunked threshold, 3.2x slower
+# — the data point the (18,30) bounds could never encode).  All five
+# measured r4/r5 cells (epsilon W16/W32, bosch W32/W64, yahoo W32/W64,
+# BENCH_NOTES.md) fall on the right side of these two constants.
+_OVERLAP_WINDOW = 52 << 20    # max live set Mosaic still overlaps
+_CHUNKED_RMW_MIN = 44 << 20   # resident size where chunked RMW kicks in
+
+
+def _plan_transient_bytes(fc, bsub, c, k, packed=False):
+    """Per-grid-step transient VMEM of the wave kernels at row tile c:
+    the repeated-bin f32 tile + bf16 one-hot (both (bsub*fc, c)), the
+    double-buffered X tile, and the bf16 hi/lo weight rows + lid/w3."""
+    xr = bsub * fc * c * 4
+    oh = bsub * fc * c * 2
+    xin = 2 * ((fc + 1) // 2 if packed else fc) * c
+    w = 2 * (3 * k * c * 2) + 16 * c
+    return xr + oh + xin + w
+
+
+def _tile_plan(n, fc, bp, row_tile, k=0, packed=False):
     """Shared tile sizing for every wave kernel: bins per inner sub-block
     (~512 lanes per one-hot tile AND a divisor of bp so the loop covers
     every bin), and the row-tile size that keeps the (Cg, bsub*fc)
     f32/bf16 temporaries within the raised VMEM budget.  One copy so the
-    policy cannot diverge across kernel layouts."""
+    policy cannot diverge across kernel layouts.
+
+    k > 0 (the wave child count) turns on the accumulator-aware bound:
+    when the resident (fc*bp, 3k) block is below the chunked-RMW
+    threshold, the row tile shrinks until resident + transients fit the
+    Mosaic overlap window — the fix for the former 18-30 MB band
+    degeneracy (thresholds above; probe: `tile_plan_vmem_report`)."""
     bsub = 1
     while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
         bsub *= 2
@@ -54,8 +96,52 @@ def _tile_plan(n, fc, bp, row_tile):
     # wrapper pads the array to exactly c
     c = max(512, min(row_tile // 128 * 128,
                      ((1 << 24) // (bsub * fc * 4)) // 128 * 128))
+    resident = fc * bp * 12 * k
+    if k and resident < _CHUNKED_RMW_MIN:
+        per_row = _plan_transient_bytes(fc, bsub, 1, k, packed)
+        cmax = ((_OVERLAP_WINDOW - resident) // per_row) // 128 * 128
+        # the old 512 floor could force an oversubscribed live set; under
+        # the accumulator-aware bound the floor relaxes to one (8, 128)
+        # lane tile so tight shapes stay schedulable instead of fast-ish
+        c = max(128, min(c, cmax))
     c = min(c, max(n, 1))
     return bsub, c
+
+
+def tile_plan_vmem_report(n, fc, bp, k, row_tile=8192, packed=False):
+    """Old-plan vs fixed-plan VMEM live-set accounting for one wave-kernel
+    shape — the minimal reproduction of the former 18-30 MB band
+    pathology and the regression probe that keeps it fixed
+    (tests/test_fused_iter.py, docs/FusedIteration.md).
+
+    Returns a dict with the legacy planner's row tile (`c_old`, fixed
+    16 MB transient budget, resident block ignored), the current
+    planner's (`c_new`), both live sets, and whether each plan lands in
+    the serialized-RMW regime (`pathological_*`)."""
+    bsub = 1
+    while bsub * 2 * fc <= 512 and bp % (bsub * 2) == 0:
+        bsub *= 2
+    c_old = max(512, min(row_tile // 128 * 128,
+                         ((1 << 24) // (bsub * fc * 4)) // 128 * 128))
+    c_old = min(c_old, max(n, 1))
+    _, c_new = _tile_plan(n, fc, bp, row_tile, k=k, packed=packed)
+    resident = fc * bp * 12 * k
+    chunked = resident >= _CHUNKED_RMW_MIN
+
+    def live(c):
+        return resident + _plan_transient_bytes(fc, bsub, c, k, packed)
+
+    return {
+        "bsub": bsub, "c_old": int(c_old), "c_new": int(c_new),
+        "resident_bytes": int(resident),
+        "live_old": int(live(c_old)), "live_new": int(live(c_new)),
+        "overlap_window": int(_OVERLAP_WINDOW),
+        "chunked_rmw": bool(chunked),
+        "pathological_old": bool(not chunked
+                                 and live(c_old) > _OVERLAP_WINDOW),
+        "pathological_new": bool(not chunked
+                                 and live(c_new) > _OVERLAP_WINDOW),
+    }
 
 
 def _round_bf16(wmat):
@@ -190,7 +276,8 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
     fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    bsub, c = _tile_plan(n, fc, bp, row_tile)
+    bsub, c = _tile_plan(n, fc, bp, row_tile, k=k,
+                         packed=bool(logical_cols))
     pad = (-n) % c
     # ROW-VECTOR layouts for the per-row operands: leaf ids as (1, N)
     # and weights as (3, N) keep TPU's (8, 128) tiling near-dense (8x /
@@ -225,7 +312,7 @@ def wave_histogram_pallas(X, leaf_id, w3, child_id, num_bins: int,
         out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(X, lid2, w3t, child_id[:, None])
@@ -286,7 +373,8 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
     fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    bsub, c = _tile_plan(n, fc, bp, row_tile)
+    bsub, c = _tile_plan(n, fc, bp, row_tile, k=k,
+                         packed=bool(logical_cols))
     pad = (-n) % c
     # row-vector operand layouts — see wave_histogram_pallas
     lid2 = (jnp.pad(leaf_id, (0, pad), constant_values=-2) if pad
@@ -316,7 +404,7 @@ def wave_histogram_pallas_t(X_t, leaf_id, w3, child_id, num_bins: int,
         out_specs=pl.BlockSpec((fc * bp, 3 * k), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(X_t, lid2, w3t, child_id[:, None])
@@ -436,7 +524,8 @@ def wave_partition_hist_pallas_ct(X_t, leaf_id, w3, child_id, cols, psrc,
     fc = logical_cols or fdev
     k = child_id.shape[0]
     bp = _bin_pad(num_bins)
-    bsub, c = _tile_plan(n, fc, bp, row_tile)
+    bsub, c = _tile_plan(n, fc, bp, row_tile, k=k,
+                         packed=bool(logical_cols))
     pad = (-n) % c
     lid2 = (jnp.pad(leaf_id, (0, pad), constant_values=-2) if pad
             else leaf_id)[None, :]                   # (1, N)
@@ -477,7 +566,7 @@ def wave_partition_hist_pallas_ct(X_t, leaf_id, w3, child_id, cols, psrc,
             jax.ShapeDtypeStruct((1, n + pad), jnp.int32),
             jax.ShapeDtypeStruct((fc * bp, 3 * k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(X_t, lid2, w3t, child_id[:, None], tblt, psrc[:, None])

@@ -18,6 +18,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.common import kMaxTreeOutput
+
 
 @jax.jit
 def apply_split(binned, leaf_id, leaf, feature, threshold, default_bin,
@@ -42,3 +44,19 @@ def leaf_outputs_to_scores(leaf_id, leaf_values, num_leaves: int):
     """Gather per-row tree output from leaf assignments (train-set score
     update via the partition, gbdt.cpp:502-515)."""
     return jnp.take(leaf_values, jnp.clip(leaf_id, 0, num_leaves - 1))
+
+
+def score_update_impl(score, leaf_id, leaf_value, scale):
+    """Traceable score += clip(scale * leaf_value)[leaf_id] — the
+    partition-side Shrinkage-clamped update (score_updater.hpp:91-99,
+    tree.h:110-118).
+
+    THE single source of the gather-form arithmetic: the staged trainer
+    reaches it through ops/predict.py's jitted wrapper and the fused
+    iteration program (ops/fused_iter.py) inlines it into its one device
+    entry — bit-identity between the two paths rests on them tracing the
+    exact same ops in the same order, so keep this free of jit wrappers
+    and dispatch logic."""
+    vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
+    gathered = vals[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)]
+    return score + gathered.astype(score.dtype)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.common import kMaxTreeOutput
+from .partition import score_update_impl
 
 
 class TraversalArrays(NamedTuple):
@@ -130,8 +131,10 @@ def add_tree_to_score(score, X, tree: TraversalArrays, scale, layout=None,
 
 @jax.jit
 def _update_score_gather(score, leaf_id, leaf_value, scale):
-    vals = jnp.clip(leaf_value * scale, -kMaxTreeOutput, kMaxTreeOutput)
-    return score + vals[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)].astype(score.dtype)
+    # single-source arithmetic shared with the fused iteration program
+    # (ops/fused_iter.py) — bit-identity depends on both paths tracing
+    # the same impl
+    return score_update_impl(score, leaf_id, leaf_value, scale)
 
 
 def _score_update_kernel(tbl_ref, lid_ref, score_ref, out_ref, *, L):

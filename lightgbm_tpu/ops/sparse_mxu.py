@@ -258,7 +258,10 @@ def sparse_wave_histogram_mxu(store: ChunkedSparseStore, leaf_id, w3,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((num_cols * bp, 3 * k),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams; accept either
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams",
+                                        None))(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(store.ent_bin, lid_e, g_e, h_e, m_e, child_id[:, None],

@@ -313,3 +313,38 @@ def find_best_split(hist, total_g, total_h, total_cnt,
     """Jitted standalone wrapper around find_best_split_impl."""
     return find_best_split_impl(hist, total_g, total_h, total_cnt, meta,
                                 feature_mask, params)
+
+
+def depth_gated_best(hist, sums, meta, feature_mask, params: SplitParams,
+                     max_depth: int, depth):
+    """Best split of one leaf with the max_depth gate applied.
+
+    `sums` is the (3,) [sum_grad, sum_hess, count] leaf total; a leaf at
+    depth >= max_depth keeps its packed vector but has its gain forced to
+    -inf so the frontier argmax can never pick it (tree.cpp max-depth
+    check hoisted into the device program).
+    """
+    b = find_best_split_impl(hist, sums[0], sums[1], sums[2], meta,
+                             feature_mask, params)
+    if max_depth > 0:
+        b = b.at[GAIN].set(jnp.where(depth < max_depth, b[GAIN], -jnp.inf))
+    return b
+
+
+def best_splits_vmapped(hists_k, sums_k, depths_k, meta, feature_mask,
+                        params: SplitParams, max_depth: int, hist_view=None):
+    """Packed best-split search vmapped over K leaves at once.
+
+    The wave engine's frontier produces K = 2*W child histograms per
+    pass; searching them as one vmapped program keeps the whole level's
+    FindBestThreshold on-device in a single fused XLA op.  `hist_view`,
+    when given, maps each leaf's raw group histogram (+ its sums) to the
+    per-feature view (EFB gather / default-bin fix) inside the vmap so
+    the view tensors never materialize for all K leaves at once outside
+    the fusion.  Shared by ops/wave.py and ops/fused_iter.py.
+    """
+    def one(h, s, d):
+        hv = hist_view(h, s) if hist_view is not None else h
+        return depth_gated_best(hv, s, meta, feature_mask, params,
+                                max_depth, d)
+    return jax.vmap(one)(hists_k, sums_k, depths_k)

@@ -47,7 +47,7 @@ from .split_finder import (DEFAULT_BIN_FOR_ZERO, FEATURE, GAIN, IS_CAT,
                            RIGHT_COUNT, RIGHT_OUTPUT, RIGHT_SUM_G,
                            RIGHT_SUM_H, SECOND_FEATURE, SECOND_GAIN,
                            SPLIT_VEC_SIZE, THRESHOLD, FeatureMeta,
-                           SplitParams, find_best_split_impl)
+                           SplitParams, best_splits_vmapped)
 
 # modes implemented only as wave-schedule Pallas kernels; every
 # engine/learner gate imports THIS tuple so adding a kernel variant is a
@@ -67,7 +67,8 @@ def _bin_pad(num_bins: int) -> int:
 def hist_block_bytes(ncols: int, bin_pad: int, width: int) -> int:
     """Bytes of the (ncols*bin_pad, 3W) f32 accumulator block the wave
     kernels keep resident in VMEM — the single geometry fact behind the
-    auto-mode VMEM gate, the pathology band, and the autotuner's cell
+    auto-mode VMEM gate, the accumulator-aware tile planner
+    (ops/pallas_wave.py _tile_plan), and the autotuner's cell
     enumeration (ops/autotune.py)."""
     return ncols * bin_pad * 12 * width
 
@@ -585,16 +586,13 @@ def make_wave_core(num_leaves: int, num_bins: int, params: SplitParams,
 
         def best_of_many(hists_k, sums_k, depths_k, feature_mask, meta,
                          bundle):
-            """vmapped packed best-split search over K children."""
-            def one(h, s, d):
-                b = find_best_split_impl(
-                    to_feature_hist(h, s, meta, bundle), s[0], s[1], s[2],
-                    meta, feature_mask, params)
-                if max_depth > 0:
-                    b = b.at[GAIN].set(jnp.where(d < max_depth, b[GAIN],
-                                                 -jnp.inf))
-                return b
-            return jax.vmap(one)(hists_k, sums_k, depths_k)
+            """vmapped packed best-split search over K children — the
+            shared split_finder helper with the EFB/default-bin view
+            applied inside the vmap."""
+            return best_splits_vmapped(
+                hists_k, sums_k, depths_k, meta, feature_mask, params,
+                max_depth,
+                hist_view=lambda h, s: to_feature_hist(h, s, meta, bundle))
 
         # ---- root
         root_sums = maybe_psum(jnp.sum(w3, axis=0))

@@ -146,6 +146,7 @@ ALIAS_TABLE: Dict[str, str] = {
     "autotune_cache": "tpu_autotune_cache",
     "autotune_cache_path": "tpu_autotune_cache",
     "autotune_waves": "tpu_autotune_waves",
+    "fused_iter": "tpu_fused_iter",
 }
 
 # canonical parameters accepted without aliasing (config.h:451-478), plus the
@@ -191,6 +192,8 @@ PARAMETER_SET = {
     "tpu_wave_compact",
     # measured kernel autotuner (ops/autotune.py)
     "tpu_autotune", "tpu_autotune_cache", "tpu_autotune_waves",
+    # fused boosting iteration (ops/fused_iter.py)
+    "tpu_fused_iter", "tpu_pallas_interpret",
     # observability (lightgbm_tpu/obs/)
     "obs_events_path", "obs_timing", "obs_memory_every",
     "obs_trace_iters", "obs_trace_dir", "obs_flush_every",
@@ -522,6 +525,23 @@ class Config:
         # timed waves per probed cell (compile + one warmup wave are
         # always excluded from the timing window)
         "tpu_autotune_waves": ("int", 3),
+        # 'auto' | 'on' | 'off' — the fused boosting iteration
+        # (ops/fused_iter.py, docs/FusedIteration.md): gradients, the
+        # grow program and the score update submitted as ONE jitted
+        # device entry per tree instead of the staged three-dispatch
+        # chain.  auto = fuse when the booster/objective shape is
+        # eligible and either the TPU wave path is live or the
+        # autotuner measured the fused cell as the winner (rev-2
+        # cells).  on = force when eligible (warns and stays staged
+        # when not).  off = always the staged chain.  Fused and staged
+        # produce bit-identical models (tests/test_fused_iter.py).
+        "tpu_fused_iter": ("str", "auto"),
+        # run the Pallas wave kernels through the interpreter on CPU
+        # (tests/CI only): exercises the real kernel bodies — tiling,
+        # accumulator layout, reduction order — without a TPU, so
+        # fused-vs-staged parity is testable end-to-end.  Ignored (with
+        # a warning) on TPU.
+        "tpu_pallas_interpret": ("bool", False),
         # observability (lightgbm_tpu/obs/): setting any of
         # obs_events_path / obs_trace_iters / obs_memory_every turns the
         # run observer on; all-defaults leaves the NULL observer in place

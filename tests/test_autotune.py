@@ -9,10 +9,16 @@
   on-disk cache (a warm cache performs ZERO probe waves), and a cache
   schema-rev bump invalidates every old entry.
 * off-TPU measure mode is a documented no-op falling back to the prior.
-* the band-escape width adjustment clamps to the first width strictly
-  past the band and reverts to the original width when no doubling can
-  clear it (wide-band regression), and the serial learner now leaves an
-  audit trail (wave_band_escape event) when it fires.
+* the former 18-30 MB band prior is GONE: its root cause (the wave
+  kernels' row-tile planner ignoring the VMEM-resident accumulator
+  block) is fixed in ops/pallas_wave.py::_tile_plan, and the
+  `tile_plan_vmem_report` regressions here pin the planner to the
+  measured cells the band used to bend — including the yahoo W64
+  misfire the (18,30) bounds could never encode.
+* a cache file written at another CACHE_SCHEMA_REV is dropped whole:
+  `load_cache` returns an empty cache, the next measure run re-probes,
+  and the rewritten file carries the current rev (no stale-rev entry
+  can be re-merged by `store_cache`).
 """
 import json
 import os
@@ -22,8 +28,7 @@ import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.ops import autotune
-from lightgbm_tpu.ops.autotune import (Cell, Pins, ShapeBucket,
-                                       band_adjusted_width, decide,
+from lightgbm_tpu.ops.autotune import (Cell, Pins, ShapeBucket, decide,
                                        enumerate_cells, measure_cells,
                                        prior_hist_mode, resolve_wave_order,
                                        resolve_wave_width, row_bucket)
@@ -47,13 +52,17 @@ def _cfg(num_leaves, **kw):
 
 # the benchmark shape buckets (tools/BENCH_SUITE.md) and the cells the
 # legacy inline heuristics picked for them on TPU; tpu_autotune=off must
-# reproduce these exactly (ncols, bin_pad, num_leaves, mode, width)
+# reproduce these exactly (ncols, bin_pad, num_leaves, mode, width).
+# The widths are the RAW ladder values: the band-escape bend that used
+# to push epsilon to 32 and bosch to 64 is gone — its root cause lives
+# in the tile planner now (test_tile_plan_* below), so the band shapes
+# run their natural widths.
 LEGACY_TABLE = [
-    ("flagship", 28, 256, 255, "pallas_t", 32),   # narrow-F, no band
-    ("epsilon", 2000, 64, 63, "pallas_t", 32),    # W16 24.6MB band -> 32
-    ("msltr", 136, 256, 255, "pallas_t", 32),     # 13.4MB, under band
+    ("flagship", 28, 256, 255, "pallas_t", 32),   # narrow-F
+    ("epsilon", 2000, 64, 63, "pallas_t", 16),    # ex-band: W16 stays 16
+    ("msltr", 136, 256, 255, "pallas_t", 32),     # 13.4MB block
     ("expo_cat", 40, 64, 31, "pallas_ct", 8),     # 40*64=2560: ct bound
-    ("bosch", 968, 64, 255, "pallas_t", 64),      # W32 23.8MB band -> 64
+    ("bosch", 968, 64, 255, "pallas_t", 32),      # ex-band: W32 stays 32
     ("bosch_widepad", 968, 256, 255, "onehot", None),  # 95MB > VMEM gate
 ]
 
@@ -67,9 +76,7 @@ def test_off_mode_matches_legacy_heuristics(name, ncols, bin_pad, leaves,
                                on_tpu=True)
     assert got_mode == mode, name
     if width is not None:
-        w = band_adjusted_width(
-            resolve_wave_width(cfg, leaves, resolve_wave_order(cfg)),
-            ncols, bin_pad)
+        w = resolve_wave_width(cfg, leaves, resolve_wave_order(cfg))
         assert w == width, name
 
 
@@ -116,11 +123,12 @@ def test_measure_mode_deterministic_winner(tmp_path):
     d = decide(cfg, ShapeBucket(8, 64, 15, 2048), prior, Pins(),
                eligible=True)
     assert d.source == "measured" and not d.cache_hit
-    # bf16 at the prior width wins under the synthetic costs
+    # bf16 at the prior width wins under the synthetic costs (which are
+    # fused-agnostic, so the fused arm ties the prior and loses the tie)
     assert d.cell == Cell("pallas_t", 8, False, False)
-    assert len(d.probes) == 5 and d.margin > 0 and d.overhead_s > 0
+    assert len(d.probes) == 6 and d.margin > 0 and d.overhead_s > 0
     probe_evs = [f for ev, f in d.events if ev == "autotune_probe"]
-    assert len(probe_evs) == 5
+    assert len(probe_evs) == 6
     assert all(f["s_per_wave"] == _bench(Cell.from_dict(f["cell"]), None)
                for f in probe_evs)
 
@@ -190,6 +198,43 @@ def test_corrupt_cache_is_empty_cache(tmp_path):
     d = decide(cfg, ShapeBucket(8, 64, 15, 2048),
                Cell("pallas_t", 8, True, False), Pins(), eligible=True)
     assert d.source == "measured"   # re-probed, did not raise
+
+
+def test_stale_rev_cache_file_dropped_whole(tmp_path):
+    """Satellite regression (v11): a cache file written at an older
+    CACHE_SCHEMA_REV is an EMPTY cache — `load_cache` must not return
+    its entries, decide() must re-probe, and the rewritten file must
+    carry the current rev with the stale entries gone (store_cache
+    merges through load_cache, so returning stale entries would
+    resurrect them under a fresh version stamp forever)."""
+    autotune.install_probe_hooks(bench=_bench)
+    cache = tmp_path / "c.json"
+    bucket = ShapeBucket(8, 64, 15, 2048)
+    stale_key = autotune.cache_key(autotune._device_kind(), bucket)
+    # a plausible rev-1 file: pre-`fused` cell dicts under rev-1 keys
+    cache.write_text(json.dumps({
+        "version": 1,
+        "entries": {
+            stale_key.replace("|v%d|" % autotune.CACHE_SCHEMA_REV,
+                              "|v1|"): {
+                "cell": {"hist_mode": "pallas_ct", "wave_width": 64,
+                         "hist_hilo": False, "compact": True},
+                "s_per_wave": 1e-9, "waves": 3},
+        }}))
+    assert autotune.load_cache(str(cache)) == {}
+    cfg = _cfg(15, tpu_autotune="measure", tpu_autotune_cache=str(cache))
+    prior = Cell("pallas_t", 8, True, False)
+    d = decide(cfg, bucket, prior, Pins(), eligible=True)
+    assert d.source == "measured" and not d.cache_hit
+    with open(cache) as f:
+        blob = json.load(f)
+    assert blob["version"] == autotune.CACHE_SCHEMA_REV
+    assert list(blob["entries"]) == [stale_key]
+    assert blob["entries"][stale_key]["cell"] == d.cell.as_dict()
+    assert "fused" in blob["entries"][stale_key]["cell"]
+    # and the fresh file is an ordinary warm cache
+    d2 = decide(cfg, bucket, prior, Pins(), eligible=True)
+    assert d2.source == "cache" and d2.cell == d.cell
 
 
 def test_force_mode_ignores_cache(tmp_path):
@@ -262,9 +307,14 @@ def test_enumerate_cells_respects_pins_and_gates():
     assert cells[0] == prior and len(cells) <= autotune.MAX_CELLS
     widths = {c.wave_width for c in cells}
     assert {4, 8, 16} <= widths
-    # fully pinned: only the prior survives
-    assert enumerate_cells(prior, bucket,
-                           Pins(True, True, True, True)) == [prior]
+    # the staged/fused flip (rev 2) is a candidate when unpinned ...
+    assert any(c.fused for c in cells)
+    # ... and fully pinned (all five dimensions) only the prior survives
+    assert enumerate_cells(
+        prior, bucket, Pins(True, True, True, True, True)) == [prior]
+    # pinning fused alone removes exactly the fused arm
+    assert all(not c.fused
+               for c in enumerate_cells(prior, bucket, Pins(fused=True)))
     # non-wave kernels have no neighbours
     assert enumerate_cells(Cell("onehot", 1, True, False), bucket,
                            Pins()) == [Cell("onehot", 1, True, False)]
@@ -294,78 +344,61 @@ def test_row_bucket_powers_of_two():
     assert row_bucket(1025) == 2048
 
 
-# ------------------------------------------------------------- band clamp
+# -------------------------------------------------- band prior post-mortem
 
-def test_band_clamp_stops_at_first_width_past_band():
-    """The escape lands on the FIRST width strictly past the upper
-    edge — it must not keep doubling once clear (regression for the
-    upper-edge clamp)."""
-    # epsilon W16: 24.6 MB in band; W32 = 49.1 MB clears -> stop at 32,
-    # even though W64 (98 MB) would be "even further past"
-    assert band_adjusted_width(16, 2000, 64) == 32
-    # bosch W32: 23.8 MB in band; W64 = 47.6 MB clears -> 64 exactly
-    assert band_adjusted_width(32, 968, 64) == 64
+# The 18-30 MB HIST_BLOCK_BAND and its band_adjusted_width escape were
+# deleted: the degeneracy was never a property of the block SIZE but of
+# the row-tile planner sizing transients against a fixed 16 MB budget
+# that ignored the VMEM-resident accumulator, so mid-size blocks
+# oversubscribed Mosaic's ~52 MB overlap window (while huge blocks were
+# rescued by the chunked-RMW schedule at ~44 MB resident).  The fix
+# lives in ops/pallas_wave.py::_tile_plan; tile_plan_vmem_report is the
+# minimal reproduction and these tests keep it fixed.
 
-
-def test_band_clamp_reverts_when_escape_cannot_clear(monkeypatch):
-    """If no doubling inside the W cap / VMEM gate lands past the band,
-    the ORIGINAL width is kept — an escape stopping at an unmeasured
-    in-band cell would trade a measured pathology for an unmeasured
-    one.  Probed with an artificially wide band."""
-    monkeypatch.setattr(autotune, "HIST_BLOCK_BAND",
-                        (18 << 20, 70 << 20))
-    # bosch W32 = 23.8 MB; doubling stops at the W=64 cap with 47.6 MB
-    # still inside the widened band -> revert to 32 (the old code would
-    # have returned the in-band 64)
-    assert band_adjusted_width(32, 968, 64) == 32
-    # epsilon W16 = 24.6 MB; W32 = 49.1 MB still in band, W64 = 98 MB
-    # would clear but violates the 64 MB VMEM gate -> revert to 16
-    assert band_adjusted_width(16, 2000, 64) == 16
-    # 1200 cols W32 = 29.5 MB -> W64 = 59 MB, still inside the widened
-    # band and the next doubling hits the W cap -> revert too
-    assert band_adjusted_width(32, 1200, 64) == 32
-    monkeypatch.setattr(autotune, "HIST_BLOCK_BAND",
-                        (18 << 20, 40 << 20))
-    # with a 40 MB upper edge W64 (47.6 MB) clears again
-    assert band_adjusted_width(32, 968, 64) == 64
+def test_band_prior_is_gone():
+    assert not hasattr(autotune, "HIST_BLOCK_BAND")
+    assert not hasattr(autotune, "band_adjusted_width")
 
 
-def test_band_escape_emits_audit_event(monkeypatch):
-    """When the serial learner's auto width escapes the band (faked TPU
-    backend, same shape as tests/test_wave.py), the escape leaves a
-    wave_band_escape event queued for the observer — it used to happen
-    silently — alongside the always-present autotune_decision."""
-    import jax
+def test_tile_plan_fixes_the_ex_band_cells():
+    """epsilon W16 and bosch W32 — the two measured in-band cells the
+    escape used to bend to wider widths — are pathological under the
+    legacy plan and schedulable under the accumulator-aware one."""
+    from lightgbm_tpu.ops.pallas_wave import tile_plan_vmem_report
+    for fc, bp, k in [(2000, 64, 16), (968, 64, 32)]:
+        rep = tile_plan_vmem_report(1 << 20, fc, bp, k)
+        assert rep["pathological_old"], (fc, k)
+        assert not rep["pathological_new"], (fc, k)
+        assert rep["c_new"] < rep["c_old"]
+        assert rep["live_new"] <= rep["overlap_window"]
 
-    from lightgbm_tpu.io.dataset import TrainingData
-    from lightgbm_tpu.ops.learner import SerialTreeLearner
-    from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
 
-    rng = np.random.default_rng(23)
-    Xw = rng.normal(size=(600, 1200))
-    yw = (Xw[:, 0] > 0).astype(np.float64)
-    cfg = Config({"num_leaves": 255, "verbose": -1, "max_bin": 63,
-                  "enable_bundle": False})
-    td = TrainingData.from_matrix(Xw, label=yw, config=cfg)
-    make_wave_core.cache_clear(); make_wave_jit.cache_clear()
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    try:
-        lrn = SerialTreeLearner(cfg, td)
-        assert lrn.wave_width == 64
-        esc = [f for ev, f in lrn._pending_events
-               if ev == "wave_band_escape"]
-        assert len(esc) == 1
-        assert esc[0]["width_from"] == 32 and esc[0]["width_to"] == 64
-        assert esc[0]["ncols"] == 1200 and esc[0]["bin_pad"] == 64
-        assert (esc[0]["band_lo_mb"] <= esc[0]["block_mb"]
-                < esc[0]["band_hi_mb"])
-        decs = [f for ev, f in lrn._pending_events
-                if ev == "autotune_decision"]
-        assert len(decs) == 1 and decs[0]["mode"] == "off"
-        assert decs[0]["cell"]["wave_width"] == 64
-    finally:
-        monkeypatch.undo()
-        make_wave_core.cache_clear(); make_wave_jit.cache_clear()
+def test_tile_plan_catches_the_band_misfire():
+    """yahoo-shaped W64 (700 cols, 64-pad): 32.8 MB resident sits OVER
+    the old band's 30 MB upper edge, so the escape declared it clear —
+    yet resident + 36 MB of transients blows the overlap window and the
+    cell measured 3.2x slow.  The live-set bound flags and fixes it;
+    the (18,30) size band never could."""
+    from lightgbm_tpu.ops.pallas_wave import tile_plan_vmem_report
+    rep = tile_plan_vmem_report(1 << 20, 700, 64, 64)
+    assert rep["resident_bytes"] > 30 << 20     # outside the old band
+    assert not rep["chunked_rmw"]               # below the chunked rescue
+    assert rep["pathological_old"]
+    assert not rep["pathological_new"]
+
+
+def test_tile_plan_leaves_healthy_cells_alone():
+    """Shapes that were never degenerate keep their full row tile: the
+    flagship (tiny resident block) and bosch W64 (45 MB resident, the
+    chunked-RMW schedule overlaps regardless of live set)."""
+    from lightgbm_tpu.ops.pallas_wave import tile_plan_vmem_report
+    flag = tile_plan_vmem_report(1 << 20, 28, 256, 32)
+    assert flag["c_new"] == flag["c_old"] == 8192
+    assert not flag["pathological_old"]
+    bosch64 = tile_plan_vmem_report(1 << 20, 968, 64, 64)
+    assert bosch64["chunked_rmw"]
+    assert bosch64["c_new"] == bosch64["c_old"]
+    assert not bosch64["pathological_new"]
 
 
 # ------------------------------------------------------------ integration

@@ -34,26 +34,30 @@ def test_wave_width_auto_policy():
     assert resolve_wave_width(cfg, 255) == 1
 
 
-def test_band_adjusted_width_escapes_pathological_blocks():
-    """Auto widths must not land in the measured 18-30 MB hist-block
-    band (epsilon W16 ran 43x slower than W32, bosch W32 10.8x slower
-    than W64 — BENCH_NOTES.md r4).  Round 5 narrowed the lower bound
-    past yahoo's 17.2 MB cell: its W=64 escape measured 3.2x SLOWER
-    (tools/BENCH_SUITE.md yahoo_w64), so that cell stays at W=32."""
-    from lightgbm_tpu.ops.learner import band_adjusted_width
-    assert band_adjusted_width(16, 2000, 64) == 32    # epsilon: 24.6 MB
-    assert band_adjusted_width(32, 968, 64) == 64     # bosch: 23.8 MB
-    assert band_adjusted_width(32, 699, 64) == 32     # yahoo: 17.2 MB stays
-    assert band_adjusted_width(32, 28, 64) == 32      # flagship: 0.7 MB
-    assert band_adjusted_width(32, 2000, 64) == 32    # already past: 49 MB
-    assert band_adjusted_width(64, 968, 64) == 64     # cap respected
+def test_tile_plan_covers_the_measured_band_cells():
+    """The 18-30 MB band escape (BENCH_NOTES.md r4/r5: epsilon W16 43x
+    slower, bosch W32 10.8x, yahoo's W=64 'escape' itself 3.2x slower)
+    was deleted — root cause was the row-tile planner ignoring the
+    VMEM-resident accumulator block (ops/pallas_wave.py::_tile_plan).
+    Every measured cell the band encoded must land right under the
+    live-set accounting: the slow cells are pathological under the old
+    plan and fixed under the new one, and the cells that measured fine
+    (yahoo W32, the flagship) keep their full row tile."""
+    from lightgbm_tpu.ops.pallas_wave import tile_plan_vmem_report
+    for fc, bp, k in [(2000, 64, 16), (968, 64, 32)]:   # epsilon, bosch
+        rep = tile_plan_vmem_report(6000, fc, bp, k)
+        assert rep["pathological_old"] and not rep["pathological_new"]
+    for fc, bp, k in [(699, 64, 32), (28, 64, 32)]:     # yahoo, flagship
+        rep = tile_plan_vmem_report(1 << 20, fc, bp, k)
+        assert not rep["pathological_old"]
+        assert rep["c_new"] == rep["c_old"]
 
 
-def test_band_escape_applies_in_serial_learner(monkeypatch):
-    """The learner applies the band escape to AUTO widths when the
-    pallas wave kernel will run (faked TPU backend): a 1200-col
-    255-leaf config's W=32 block (29.5 MB) sits in the band, so auto
-    resolves to W=64; an explicit width passes through untouched."""
+def test_auto_width_no_longer_bent_in_serial_learner(monkeypatch):
+    """With the band escape gone the learner's AUTO width is exactly the
+    resolve_wave_width ladder even where the pallas wave kernel will run
+    (faked TPU backend; the 1200-col 255-leaf shape used to bend
+    32 -> 64), and an explicit width still passes through untouched."""
     import jax
     from lightgbm_tpu.ops.learner import SerialTreeLearner
     from lightgbm_tpu.ops.wave import make_wave_core, make_wave_jit
@@ -69,11 +73,13 @@ def test_band_escape_applies_in_serial_learner(monkeypatch):
     try:
         lrn = SerialTreeLearner(cfg, td)
         assert lrn.hist_mode == "pallas_t"       # wide-F kernel
-        assert lrn.wave_width == 64              # escaped the band
+        assert lrn.wave_width == 32              # raw ladder, no bend
+        assert not [ev for ev, _ in lrn._pending_events
+                    if ev == "wave_band_escape"]
         cfg2 = Config({"num_leaves": 255, "verbose": -1, "max_bin": 63,
-                       "enable_bundle": False, "tpu_wave_width": 32})
+                       "enable_bundle": False, "tpu_wave_width": 16})
         lrn2 = SerialTreeLearner(cfg2, td)
-        assert lrn2.wave_width == 32             # explicit width wins
+        assert lrn2.wave_width == 16             # explicit width wins
     finally:
         monkeypatch.undo()
         make_wave_core.cache_clear(); make_wave_jit.cache_clear()

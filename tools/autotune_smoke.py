@@ -13,6 +13,14 @@ timings, no kernels executed) and forcing the wave growth schedule:
 
 Also asserts `obs explain` renders the decision section.  Exits
 nonzero on any violation.  See docs/Autotuning.md.
+
+``--fused`` runs the fused-iteration smoke instead (ops/fused_iter.py,
+docs/FusedIteration.md): trains with ``tpu_fused_iter=on`` on CPU,
+asserts the model is bit-identical to the staged chain, that the
+``fused_iter`` entry compiled, and that the fused run passes the same
+same-signature-recompile check as ``obs recompiles --check`` (the
+single-compile contract — a fused program that recompiles per
+iteration would silently give back everything fusion buys).
 """
 import json
 import os
@@ -53,6 +61,76 @@ def train_once(lgb, X, y, cache_dir, events_path):
     lgb.train(params, lgb.Dataset(X, label=y, params=params),
               num_boost_round=2)
     return events_of(events_path)
+
+
+def fused_main():
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import query
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((1500, 10)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    fails = []
+
+    def check(cond, msg):
+        if not cond:
+            fails.append(msg)
+            print("FAIL: %s" % msg)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ev_path = os.path.join(tmp, "fused.jsonl")
+        fused_params = {
+            "objective": "binary", "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbose": -1,
+            "tpu_fused_iter": "on", "obs_events_path": ev_path,
+            "obs_compile": True,
+            # the fused program hides the staged g/h the health/audit
+            # instruments read between stages
+            "obs_health": "off", "obs_split_audit": False,
+            "obs_importance_every": 0, "obs_ledger_dir": "",
+        }
+        staged_params = dict(fused_params, tpu_fused_iter="off",
+                             obs_events_path="")
+        bst_f = lgb.train(fused_params,
+                          lgb.Dataset(X, label=y, params=fused_params),
+                          num_boost_round=6)
+        bst_s = lgb.train(staged_params,
+                          lgb.Dataset(X, label=y, params=staged_params),
+                          num_boost_round=6)
+
+        check(bst_f._gbdt._fused_state[0] is not None,
+              "tpu_fused_iter=on did not resolve to the fused program")
+        check(bst_f.model_to_string() == bst_s.model_to_string(),
+              "fused model differs from the staged chain")
+        check((bst_f.predict(X) == bst_s.predict(X)).all(),
+              "fused predictions differ from the staged chain")
+
+        evs = events_of(ev_path)
+        check(any(e.get("ev") == "compile"
+                  and e.get("entry") == "fused_iter" for e in evs),
+              "fused run never compiled the fused_iter entry")
+        iters = [e for e in evs if e.get("ev") == "iter"]
+        check(bool(iters) and all(
+            e.get("host_orchestration_s", -1.0) >= 0.0 for e in iters),
+            "fused timeline missing host_orchestration_s")
+
+        # the `obs recompiles --check` gate on the fused timeline: no
+        # entry may recompile a signature it already compiled
+        import io
+        buf = io.StringIO()
+        thrash = query.render_recompiles(evs, out=buf)
+        check(thrash is False,
+              "fused run thrashed the jit cache:\n%s" % buf.getvalue())
+
+    if fails:
+        print("fused smoke: %d failure(s)" % len(fails))
+        return 1
+    print("fused smoke: OK (fused == staged over 6 rounds, "
+          "single fused_iter compile)")
+    return 0
 
 
 def main():
@@ -127,4 +205,4 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(fused_main() if "--fused" in sys.argv[1:] else main())

@@ -42,7 +42,7 @@ Usage:
         [--tol-recompile 0] [--tol-eval 0.02] \
         [--tol-serve-qps 0.15] [--tol-serve-p99 0.30] \
         [--tol-serve-shed 0.25] [--tol-autotune 0.50] \
-        [--tol-construct 0.30] [--json]
+        [--tol-construct 0.30] [--tol-host-orch 0.50] [--json]
 
 Exit codes: 0 pass, 1 regression beyond tolerance, 2 load/usage error.
 """
@@ -98,6 +98,14 @@ METRICS = {
     # reports sketch_s == bin_s == 0, so candidate-vs-baseline catches
     # both slow binning AND accidental re-binning of a binned artifact
     "construct_s": (-1, 0.30),
+    # mean host seconds between device program submissions per iteration
+    # (schema v11 iter field, models/gbdt.py OrchestrationClock) — the
+    # number the fused iteration (ops/fused_iter.py) drives to ~0.  A
+    # fused baseline sits near zero where scheduler jitter is a large
+    # relative move, so the tolerance is wide (50%) — the gate is for
+    # real orchestration creep (a new host sync, a regrown glue path),
+    # which shows up as multiples, not percentages
+    "host_orchestration_s": (-1, 0.50),
 }
 
 
@@ -162,6 +170,12 @@ def _from_timeline(events):
     if decs:
         out["autotune_overhead_s"] = sum(
             float(e.get("overhead_s", 0.0)) for e in decs)
+    # host-orchestration glue (schema v11): mean over the run's iter
+    # records; older timelines without the field simply skip the metric
+    orch = [float(e["host_orchestration_s"]) for e in iters
+            if "host_orchestration_s" in e]
+    if orch:
+        out["host_orchestration_s"] = sum(orch) / len(orch)
     # dataset-construction cost (schema v9): sum over dataset_construct
     # events of the run (train + valid sets all count toward the gate)
     cons = [e for e in events if e.get("ev") == "dataset_construct"]
@@ -423,6 +437,10 @@ def main(argv=None):
         help="dataset-construction time relative tolerance (a "
              "pre-binned zero-rebin baseline fails on ANY candidate "
              "re-binning)")
+    ap.add_argument("--tol-host-orch", type=float, default=METRICS[
+        "host_orchestration_s"][1],
+        help="per-iteration host-orchestration seconds relative "
+             "tolerance (schema v11; the fused-iteration gate)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
@@ -434,7 +452,8 @@ def main(argv=None):
             "serve_p99_s": args.tol_serve_p99,
             "serve_shed_rate": args.tol_serve_shed,
             "autotune_overhead_s": args.tol_autotune,
-            "construct_s": args.tol_construct}
+            "construct_s": args.tol_construct,
+            "host_orchestration_s": args.tol_host_orch}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

@@ -117,6 +117,19 @@ NOTES = {
                           "cache",
     "tpu_autotune_waves": "timed waves per probed cell in measure/force "
                           "mode (plus one untimed warmup wave)",
+    "tpu_fused_iter": "auto / on / off — run each boosting iteration as "
+                      "ONE fused device program (gradients + tree growth "
+                      "+ score update, ops/fused_iter.py) instead of the "
+                      "staged entry chain; bit-identical models either "
+                      "way.  auto = fuse where the Pallas wave kernels "
+                      "are active or the autotuner measured the fused "
+                      "cell as the winner; ineligible configs (DART/"
+                      "GOSS/multiclass/custom fobj/obs_health) always "
+                      "use the staged chain; see FusedIteration.md",
+    "tpu_pallas_interpret": "true / false — run the Pallas wave kernels "
+                            "in interpret mode (CPU-executable, for "
+                            "tests and parity checks; ignored with a "
+                            "warning on TPU)",
     "tpu_sparse": "true / false — device-side sparse bin store (exact "
                   "engine, serial + data-parallel; histograms from "
                   "nonzeros only)",
@@ -280,7 +293,7 @@ GROUPS = [
         "tpu_wave_lookup", "tpu_wave_compact", "tpu_histogram_mode",
         "tpu_hist_precision", "tpu_score_update", "tpu_bin_pack",
         "tpu_sparse", "tpu_sparse_kernel", "tpu_use_dp", "tpu_predict",
-        "tpu_profile_dir"]),
+        "tpu_fused_iter", "tpu_pallas_interpret", "tpu_profile_dir"]),
     ("Autotune", [
         "tpu_autotune", "tpu_autotune_cache", "tpu_autotune_waves"]),
     ("Observability", [
