@@ -439,7 +439,19 @@ def dry():
     """Tier-1-safe telemetry smoke (CI: JAX_PLATFORMS=cpu python bench.py
     --dry): train a tiny shape with obs enabled and assert the emitted
     JSONL parses as a schema-valid timeline — so a telemetry regression
-    is caught before the next on-chip bench window, not during it."""
+    is caught before the next on-chip bench window, not during it.
+
+    Several of the runtime asserts below now have a static twin in the
+    CI lint gate (`python -m lightgbm_tpu lint --check`,
+    docs/StaticAnalysis.md), which catches the violation class at
+    compile time instead of only on the paths this dry run happens to
+    exercise: the fence-count flatness assert (hostsync pass — every
+    hot-path sync must be a counted fence()/fenced_get()), the
+    recompile-thrash assert (recompile pass — jit-in-loop and static-arg
+    hazards), the event-schema validity of the timeline (events pass
+    over every emit site), and the VMEM-budget asserts of the on-chip
+    wave kernels (vmem pass sweeping the tile planners).  The asserts
+    stay: the lint proves the code shape, this proves the behavior."""
     from lightgbm_tpu.utils.common import honor_jax_platforms
     honor_jax_platforms()
     import lightgbm_tpu as lgb
@@ -576,9 +588,10 @@ def dry():
     # obs/timers.fence, so its counter is a complete audit — with the
     # NULL observer and no autotune probe the boosting loop must leave
     # it untouched (the async-dispatch contract the fused iteration and
-    # the staged fast path both rely on).  The periodic stop-check sync
-    # uses jax.device_get and only fires every 16 iters; the warmup
-    # update below burns iteration 0 so the audited window is clean.
+    # the staged fast path both rely on).  The periodic stop-check
+    # readback is counted too (obs/timers.fenced_get — the hostsync
+    # lint pass enforces that spelling) but only fires every 16 iters;
+    # the warmup update below burns iteration 0 so the window is clean.
     from lightgbm_tpu.obs import timers as obs_timers
     bst_def = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
                                   "max_bin": 15, "verbose": -1},

@@ -1,0 +1,234 @@
+"""Pass 1 — host-sync: implicit device->host syncs in hot-path modules.
+
+PR-11's fence-count invariant (bench.py --dry asserts fence_count() is
+flat across default-config iterations) proves the TRAINED code paths
+stay async; this pass proves it for every path in the hot-path scope
+(ops/, models/gbdt.py, serve/), compiled or not, at CI time.  An
+implicit sync — ``float(tracer)``, ``.item()``, ``np.asarray(devarr)``,
+``jax.device_get``, ``.block_until_ready()`` — stalls XLA's async
+dispatch pipeline exactly like the reference's queue.finish() between
+OpenCL kernels would; the sanctioned escape hatch is
+``obs/timers.fence``, which syncs AND counts itself so the runtime
+audit sees it.
+
+Taint model (deliberately first-order, one forward sweep per scope):
+a name is a *device value* if it was assigned from an expression rooted
+at ``jnp`` / ``jax`` / ``lax`` (minus the host-returning ``device_get``
+family) or derived from another device value by attribute/index/arith —
+except shape/dtype metadata, which XLA keeps on host.  The sweep is
+flow-SENSITIVE in source order: a use at line N only sees taints from
+assignments before N, so re-binding a host name to a device value later
+(``V = np.concatenate(...)`` then ``V = jax.device_put(V)``) does not
+retroactively indict the host phase.  The cost is missing a sync whose
+device assignment arrives later in a loop body — precision over recall:
+a lint gate the tree can't pass clean teaches people to sprinkle
+suppressions.  Scalar casts, ``.item()`` and ``asarray`` only fire on
+values the sweep can prove device-resident; ``block_until_ready`` /
+``device_get`` are syncs by definition and fire unconditionally.  The
+sanctioned spellings are ``obs/timers.fence`` (sync-and-count) and
+``obs/timers.fenced_get`` (readback-and-count) — both audited by
+``fence_count()``, neither flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, SourceModule, dotted_name
+
+PASS_NAME = "hostsync"
+
+RULES = {
+    "sync-block-until-ready":
+        "block_until_ready() in a hot-path module; route through "
+        "obs/timers.fence so the sync is counted",
+    "sync-device-get":
+        "jax.device_get in a hot-path module forces a device->host copy",
+    "sync-item":
+        ".item() on a device value blocks on the async computation",
+    "sync-scalar-cast":
+        "float()/int()/bool() on a device value is an implicit sync",
+    "sync-asarray":
+        "np.asarray/np.array on a device value is an implicit "
+        "device->host transfer",
+}
+
+_DEVICE_ROOTS = {"jnp", "lax"}
+_NP_NAMES = {"np", "numpy", "onp"}
+_HOST_RETURNING = {
+    "jax.device_get", "jax.tree_util.tree_map",
+}
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+# aval metadata jax keeps on host: reading x.shape[0] never syncs
+_HOST_META_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes",
+                    "sharding", "weak_type"}
+# the counted readback (obs/timers) — sanctioned, host-returning
+_SANCTIONED_GETS = {"fenced_get", "fence"}
+
+
+def _is_device_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    """Can this expression be PROVEN to produce a jax device value?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _HOST_META_ATTRS:
+            return False
+        return _is_device_expr(node.value, tainted)
+    if isinstance(node, (ast.Subscript, ast.Starred)):
+        return _is_device_expr(node.value, tainted)
+    if isinstance(node, ast.BinOp):
+        return (_is_device_expr(node.left, tainted)
+                or _is_device_expr(node.right, tainted))
+    if isinstance(node, ast.UnaryOp):
+        return _is_device_expr(node.operand, tainted)
+    if isinstance(node, ast.IfExp):
+        return (_is_device_expr(node.body, tainted)
+                or _is_device_expr(node.orelse, tainted))
+    if isinstance(node, ast.Compare):
+        # comparisons on device values are device bools
+        return (_is_device_expr(node.left, tainted)
+                or any(_is_device_expr(c, tainted)
+                       for c in node.comparators))
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _HOST_RETURNING:
+            return False
+        if name and name.rsplit(".", 1)[-1] in _SANCTIONED_GETS:
+            return False             # counted readback lands on host
+        root = name.split(".", 1)[0] if name else ""
+        if root in _DEVICE_ROOTS:
+            return True
+        if root == "jax":
+            return True
+        if isinstance(node.func, ast.Attribute):
+            # method on a device value: x.sum(), x.astype() stay device;
+            # x.item()/x.tolist() land on host
+            if node.func.attr in _HOST_METHODS:
+                return False
+            return _is_device_expr(node.func.value, tainted)
+    return False
+
+
+def walk_scope(body: List[ast.stmt]):
+    """Yield every node in these statements WITHOUT descending into
+    nested function/class definitions — each nested scope is scanned on
+    its own, with its own taint set."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue        # nested scope: scanned on its own
+        # ClassDef is descended: class-level statements execute in the
+        # enclosing scope (its methods are still separate scopes)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _apply_assign(node: ast.AST, tainted: Set[str]) -> None:
+    """Update the taint set for one assignment statement.  A host RHS
+    over-writes (un-taints) a simple name target — that is what makes
+    the sweep flow-sensitive rather than sticky."""
+    if isinstance(node, ast.Assign):
+        value, targets = node.value, node.targets
+    elif isinstance(node, ast.AnnAssign) and node.value:
+        value, targets = node.value, [node.target]
+    elif isinstance(node, ast.AugAssign):
+        value, targets = node.value, [node.target]
+    else:
+        return
+    device = _is_device_expr(value, tainted)
+    for t in targets:
+        if isinstance(t, ast.Name):
+            if device:
+                tainted.add(t.id)
+            elif not isinstance(node, ast.AugAssign):
+                tainted.discard(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)) and device:
+            # conservative: a device-producing RHS taints every
+            # unpacked name
+            for el in t.elts:
+                if isinstance(el, ast.Name):
+                    tainted.add(el.id)
+
+
+def _scan_scope(mod: SourceModule, body: List[ast.stmt],
+                findings: List[Finding]) -> None:
+    # one forward sweep in source order: each call site is judged with
+    # exactly the taints accumulated above it (see module docstring)
+    tainted: Set[str] = set()
+    nodes = sorted(walk_scope(body),
+                   key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+    for node in nodes:
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            _apply_assign(node, tainted)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name and name.rsplit(".", 1)[-1] in _SANCTIONED_GETS:
+            continue                 # obs/timers counted sync — audited
+        # -- unconditional syncs --------------------------------
+        if name == "jax.block_until_ready" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            findings.append(Finding(
+                "sync-block-until-ready", PASS_NAME, mod.path,
+                node.lineno,
+                "explicit device sync on the hot path",
+                "use obs/timers.fence(value) so the sync is "
+                "audited, or hoist it off the hot path"))
+            continue
+        if name == "jax.device_get":
+            findings.append(Finding(
+                "sync-device-get", PASS_NAME, mod.path, node.lineno,
+                "jax.device_get forces a blocking device->host copy",
+                "keep the value on device, or fence() it where the "
+                "phase accounting expects a sync"))
+            continue
+        # -- taint-gated syncs ----------------------------------
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args \
+                and _is_device_expr(node.func.value, tainted):
+            findings.append(Finding(
+                "sync-item", PASS_NAME, mod.path, node.lineno,
+                ".item() on a device value blocks the dispatch "
+                "pipeline",
+                "batch the readback or route through fence()"))
+            continue
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") \
+                and len(node.args) == 1 \
+                and _is_device_expr(node.args[0], tainted):
+            findings.append(Finding(
+                "sync-scalar-cast", PASS_NAME, mod.path, node.lineno,
+                "%s() on a device value is an implicit sync"
+                % node.func.id,
+                "keep the scalar on device (jnp.where/lax.cond) or "
+                "fence() the readback"))
+            continue
+        root = name.split(".", 1)[0] if name else ""
+        if root in _NP_NAMES and name.endswith((".asarray", ".array")) \
+                and node.args \
+                and _is_device_expr(node.args[0], tainted):
+            findings.append(Finding(
+                "sync-asarray", PASS_NAME, mod.path, node.lineno,
+                "%s on a device value is an implicit device->host "
+                "transfer" % name,
+                "stay in jnp, or device_get once at a fenced "
+                "boundary"))
+
+
+def run(modules: List[SourceModule], repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        if not mod.in_hot_path():
+            continue
+        scopes: List[List[ast.stmt]] = [mod.tree.body]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            _scan_scope(mod, body, findings)
+    return findings

@@ -1,0 +1,240 @@
+"""Pass 2 — recompile-hazard: statically enforce what ``obs recompiles
+--check`` only observes.
+
+The runtime tracker (obs/compile.py) attributes a steady-state recompile
+to the argument signature that changed — after the device time is
+already burned.  Three hazards are decidable from the AST alone:
+
+* ``jit-in-loop`` — ``jax.jit`` (or ``functools.partial(jax.jit, ...)``)
+  called inside a ``for``/``while`` body builds a NEW jitted callable
+  (and a new jit cache) every trip; nothing ever hits warm.  The
+  trackers would report it as an entry rebuild — this rejects it before
+  it runs.
+* ``jit-static-drift`` — a ``static_argnames`` entry that names no
+  parameter of the decorated function, or a ``static_argnums`` index out
+  of range.  jax errors on some of these only at call time, and a
+  misspelled static name silently demotes the argument to traced — the
+  exact drift class the rule name comes from.
+* ``jit-unhashable-static`` — a dict/set/list literal passed in a static
+  position of a module-local jitted function: ``TypeError: unhashable
+  type`` at call time, found at lint time instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional
+
+from .core import Finding, SourceModule, dotted_name, str_const
+from .hostsync import walk_scope
+
+PASS_NAME = "recompile"
+
+RULES = {
+    "jit-in-loop":
+        "jax.jit called inside a loop body re-creates the jitted "
+        "callable (and its cache) every iteration",
+    "jit-static-drift":
+        "static_argnames/static_argnums names a parameter the function "
+        "does not have",
+    "jit-unhashable-static":
+        "unhashable literal (dict/set/list) passed as a static argument "
+        "of a jitted entry",
+}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / functools.partial(jax.jit, ...) / partial(jax.jit, ...)"""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jit_call_node(node: ast.AST) -> Optional[ast.Call]:
+    """The Call whose keywords carry static_argnames/nums, if this
+    expression is a jit application with arguments."""
+    if isinstance(node, ast.Call) and _is_jit_expr(node):
+        return node
+    return None
+
+
+class JitEntry(NamedTuple):
+    fn: ast.FunctionDef
+    static_names: List[str]      # resolved static parameter NAMES
+    decorator_line: int
+
+
+def _str_items(node: ast.AST) -> Optional[List[str]]:
+    """["a", "b"] for a str constant or tuple/list of str constants."""
+    s = str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = str_const(el)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def _int_items(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def _check_decorator(mod: SourceModule, fn: ast.FunctionDef,
+                     jit_call: ast.Call,
+                     findings: List[Finding]) -> List[str]:
+    """Validate static_argnames/nums against the signature; return the
+    resolved static parameter names for call-site checking."""
+    params = _param_names(fn)
+    positional = ([p.arg for p in fn.args.posonlyargs]
+                  + [p.arg for p in fn.args.args])
+    static: List[str] = []
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            names = _str_items(kw.value)
+            if names is None:
+                continue            # dynamic expression: not decidable
+            for n in names:
+                if n not in params:
+                    findings.append(Finding(
+                        "jit-static-drift", PASS_NAME, mod.path,
+                        jit_call.lineno,
+                        "static_argnames %r is not a parameter of %s()"
+                        % (n, fn.name),
+                        "rename the entry in static_argnames or the "
+                        "parameter — a misspelled name silently traces "
+                        "the argument"))
+                else:
+                    static.append(n)
+        elif kw.arg == "static_argnums":
+            nums = _int_items(kw.value)
+            if nums is None:
+                continue
+            for i in nums:
+                j = i + len(positional) if i < 0 else i
+                if not 0 <= j < len(positional):
+                    findings.append(Finding(
+                        "jit-static-drift", PASS_NAME, mod.path,
+                        jit_call.lineno,
+                        "static_argnums %d is out of range for %s() "
+                        "(%d positional parameters)"
+                        % (i, fn.name, len(positional)),
+                        "re-point static_argnums at the intended "
+                        "parameter"))
+                else:
+                    static.append(positional[j])
+    return static
+
+
+_UNHASHABLE = (ast.Dict, ast.Set, ast.List, ast.DictComp, ast.SetComp,
+               ast.ListComp)
+
+
+def _check_call_sites(mod: SourceModule, entries: Dict[str, JitEntry],
+                      findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        entry = entries.get(fname) or entries.get(
+            fname.rsplit(".", 1)[-1] if "." in fname else "")
+        if entry is None:
+            continue
+        positional = ([p.arg for p in entry.fn.args.posonlyargs]
+                      + [p.arg for p in entry.fn.args.args])
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break               # positions unknowable past a splat
+            if i < len(positional) and positional[i] in entry.static_names \
+                    and isinstance(arg, _UNHASHABLE):
+                findings.append(Finding(
+                    "jit-unhashable-static", PASS_NAME, mod.path,
+                    arg.lineno,
+                    "unhashable literal passed for static parameter %r "
+                    "of %s()" % (positional[i], entry.fn.name),
+                    "pass a hashable (tuple / frozenset / scalar) — "
+                    "static args key the jit cache"))
+        for kw in node.keywords:
+            if kw.arg in entry.static_names \
+                    and isinstance(kw.value, _UNHASHABLE):
+                findings.append(Finding(
+                    "jit-unhashable-static", PASS_NAME, mod.path,
+                    kw.value.lineno,
+                    "unhashable literal passed for static parameter %r "
+                    "of %s()" % (kw.arg, entry.fn.name),
+                    "pass a hashable (tuple / frozenset / scalar) — "
+                    "static args key the jit cache"))
+
+
+def _check_jit_in_loop(mod: SourceModule,
+                       findings: List[Finding]) -> None:
+    """Flag jax.jit applications syntactically inside a loop body.
+
+    Scoped per function (walk_scope) so a jit in a factory function that
+    is itself CALLED from a loop is the caller's problem, not a textual
+    false positive here."""
+    scopes: List[List[ast.stmt]] = [mod.tree.body]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for body in scopes:
+        loops = [n for n in walk_scope(body)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        for loop in loops:
+            for node in walk_scope([loop]):
+                if isinstance(node, ast.Call) and _is_jit_expr(node) \
+                        and dotted_name(node.func) in ("jax.jit", "jit"):
+                    findings.append(Finding(
+                        "jit-in-loop", PASS_NAME, mod.path, node.lineno,
+                        "jax.jit inside a loop builds a fresh jitted "
+                        "callable every iteration — its cache never "
+                        "hits warm",
+                        "hoist the jit out of the loop (build once, "
+                        "call many)"))
+
+
+def run(modules: List[SourceModule], repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        entries: Dict[str, JitEntry] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for dec in node.decorator_list:
+                jc = _jit_call_node(dec)
+                if jc is None:
+                    if _is_jit_expr(dec):
+                        entries[node.name] = JitEntry(node, [],
+                                                      node.lineno)
+                    continue
+                static = _check_decorator(mod, node, jc, findings)
+                entries[node.name] = JitEntry(node, static, node.lineno)
+        if entries:
+            _check_call_sites(mod, entries, findings)
+        _check_jit_in_loop(mod, findings)
+    return findings

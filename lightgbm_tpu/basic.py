@@ -10,9 +10,8 @@ round-trip, continued training via ``init_model``.
 """
 from __future__ import annotations
 
-import copy as _copy
 import time as _time
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -22,7 +21,7 @@ from .models.gbdt import GBDT
 from .models.factory import create_boosting
 from .objectives import create_objective
 from .obs.metrics import observe_predict
-from .utils.config import Config, param_dict_to_str
+from .utils.config import Config
 from .utils.log import LightGBMError, Log
 
 __all__ = ["Dataset", "Booster", "LightGBMError"]

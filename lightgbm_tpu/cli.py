@@ -13,8 +13,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from .basic import Booster, Dataset
-from .engine import train as train_api
+from .basic import Booster
 from .metrics import create_metric
 from .models.factory import create_boosting
 from .objectives import create_objective
@@ -175,6 +174,11 @@ def main(argv=None) -> int:
         #                              diff|trace ...
         from .obs.query import main as obs_main
         return obs_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # graftlint static analyzer (docs/StaticAnalysis.md):
+        #   python -m lightgbm_tpu lint [--check] [--json] [--baseline F]
+        from .analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     params = parse_cli_params(argv)
     params = key_alias_transform(params, raise_unknown=False)
     cfg = Config(params)

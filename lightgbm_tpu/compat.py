@@ -2,8 +2,8 @@
 from __future__ import annotations
 
 try:
-    import pandas as pd
-    from pandas import DataFrame, Series
+    import pandas as pd  # noqa: F401 — re-exported shim
+    from pandas import DataFrame, Series  # noqa: F401
     PANDAS_INSTALLED = True
 except ImportError:
     PANDAS_INSTALLED = False

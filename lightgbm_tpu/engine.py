@@ -4,8 +4,7 @@ exception, init_model continuation, stratified/group folds)."""
 from __future__ import annotations
 
 import collections
-import copy
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 import numpy as np
 

@@ -27,7 +27,7 @@ Scores layout is the reference's column-major flat array, shaped
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +36,7 @@ import numpy as np
 from ..io.dataset import TrainingData
 from ..metrics import Metric
 from ..obs import NULL_OBSERVER, observer_from_config
-from ..obs.timers import OrchestrationClock
+from ..obs.timers import OrchestrationClock, fenced_get
 from ..objectives import ObjectiveFunction, load_objective_from_string
 from ..ops.learner import SerialTreeLearner, materialize_tree
 from ..ops import predict as dev_predict
@@ -442,7 +442,7 @@ class GBDT:
         devs = [self._models_dev[i] for i in pending]
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *devs) \
             if len(devs) > 1 else devs[0]
-        host = jax.device_get(stacked)
+        host = fenced_get(stacked)      # counted: one sync per batch
         for j, i in enumerate(pending):
             ht = jax.tree_util.tree_map(lambda x: x[j], host) \
                 if len(devs) > 1 else host
@@ -712,7 +712,7 @@ class GBDT:
         if num_leaves_this_iter:
             if is_eval or (self.iter % 16 == 0):
                 should_continue = any(int(nl) > 1
-                                      for nl in jax.device_get(num_leaves_this_iter))
+                                      for nl in fenced_get(num_leaves_this_iter))
         else:
             should_continue = False
         if not should_continue:
@@ -1013,7 +1013,7 @@ class GBDT:
         def drain(pending):
             plo, pscore, pnrows = pending
             out[plo:plo + pnrows] = np.asarray(
-                jax.device_get(pscore)[:pnrows], np.float64)
+                fenced_get(pscore)[:pnrows], np.float64)
 
         # one-deep pipeline: encode chunk i+1 on the host while the
         # device computes chunk i (jax dispatch is async; device_get is

@@ -13,7 +13,7 @@ Decision semantics (tree.h:229-276):
 """
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Optional
+from typing import List, NamedTuple
 
 import numpy as np
 

@@ -169,6 +169,85 @@ _REQUIRED = {
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
+# ev -> keys a writer MAY attach beyond _REQUIRED.  Every field any
+# in-tree emit site produces must be declared in one of the two tables:
+# the event-schema lint pass (analysis/events_schema.py) rejects an
+# emit-site keyword found in neither, so a new field is a deliberate
+# schema decision here rather than silent drift (the PR-6->7
+# ``serve_batch`` under-promise, re-litigated statically).  Readers must
+# still treat these as optional — old timelines predate them.
+_OPTIONAL = {
+    "run_header": ("rank", "world_size", "coordinator", "provenance",
+                   # obs/merge.py synthetic pod-merged header
+                   "merged", "merged_ranks"),
+    "iter": ("seq", "stopped", "host_orchestration_s",
+             # obs/merge.py critical-path merge
+             "rank_times", "skew_s", "slowest_rank"),
+    "compile": (),
+    # attribution extras (obs/compile.py / serve/executable.py):
+    # per-signature counts, field-level diff, jit cache size, AOT
+    # cost/memory analysis when the backend exposes them
+    "compile_attr": ("sig_compiles", "diff", "cache_size", "cost",
+                     "memory"),
+    "straggler": ("axis", "slowest", "total_s"),
+    "memory": (),
+    "trace_window": (),
+    # parallel/mesh.py collective_info(): static topology + per-collective
+    # byte estimates; exact keys vary by learner
+    "collectives": ("axis", "n_devices", "n_processes", "global_rows",
+                    "estimates", "psum", "allgather",
+                    "num_voting_machines"),
+    "host_collective": ("t_start", "nbytes",
+                        # obs/merge.py aligned-collective merge
+                        "skew_s", "first_rank", "last_rank", "arrivals",
+                        "missing_ranks"),
+    "health": ("detail",),
+    "metrics": (),
+    "split_audit": ("num_leaves", "shrinkage", "truncated"),
+    "importance": ("n_features", "n_used", "split", "gain"),
+    # the profile payload (io/dataset.py _profile_quality) rides in via
+    # **profile; its stat keys are the profiler's contract, not ours
+    "data_profile": ("dataset", "label", "findings", "n_rows", "stats"),
+    "eval": (),
+    "serve_batch": ("kind",),
+    # bench_serve.py load-generator summary extras
+    "serve_bench": ("requests", "rows", "rows_per_s", "threads",
+                    "wall_s", "batches", "pad_rows", "buckets",
+                    "offered", "shed", "shed_rate", "deadline_ms",
+                    "steady_state_compiles"),
+    "serve_request": ("kind", "batch", "requests", "total_s",
+                      "deadline_s"),
+    "serve_slo": ("short_s", "overall", "alert", "burn_short",
+                  "burn_long", "targets", "verdicts"),
+    "serve_summary": ("pad_rows", "max_queue_depth", "requests", "shed",
+                      "executables", "slo"),
+    "autotune_probe": ("bucket", "waves"),
+    "autotune_decision": ("bucket", "device_kind", "prior", "cells",
+                          "margin", "overhead_s", "cache_hit",
+                          "cache_path"),
+    # dead writer (band prior removed in PR-11) — field set preserved for
+    # the old-timeline renderer in obs/query.py
+    "wave_band_escape": ("band_lo_mb", "band_hi_mb", "block_mb", "ncols",
+                         "bin_pad"),
+    "dataset_construct": ("source", "construct_s"),
+    "run_end": ("status", "health", "compile_attr", "stragglers",
+                # obs/merge.py merged-timeline summary
+                "rank_report"),
+}
+
+# fields event()/emit() stamp on every record regardless of type
+_COMMON_FIELDS = ("ev", "t", "run", "rank")
+
+
+def declared_fields(ev):
+    """Frozenset of every field the schema knows for ``ev`` (required +
+    optional + common), or None for an unknown event type.  The static
+    analyzer keys its unknown-field rule on this."""
+    if ev not in _REQUIRED:
+        return None
+    return frozenset(_REQUIRED[ev]) | frozenset(_OPTIONAL.get(ev, ())) \
+        | frozenset(_COMMON_FIELDS)
+
 
 # -- run provenance ------------------------------------------------------
 # Stamped into every schema-10 run_header: the git rev (and whether the

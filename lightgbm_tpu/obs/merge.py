@@ -40,7 +40,7 @@ import glob
 import json
 import os
 
-from .events import SCHEMA_VERSION, collect_provenance, read_events
+from .events import SCHEMA_VERSION, collect_provenance
 
 __all__ = ["discover_shards", "load_shards", "merge_shards",
            "render_report", "write_merged"]

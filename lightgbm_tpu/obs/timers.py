@@ -21,7 +21,7 @@ _FENCE_COUNT = 0
 
 
 def fence_count() -> int:
-    """Total fence() calls that reached block_until_ready (sync audit)."""
+    """Total fence()/fenced_get() syncs issued (sync audit)."""
     return _FENCE_COUNT
 
 
@@ -41,6 +41,23 @@ def fence(value):
         jax.block_until_ready(value)
     except Exception:       # non-jax value, or backend already torn down
         pass
+
+
+def fenced_get(value):
+    """``jax.device_get`` that counts itself in the sync audit.
+
+    The counted twin of ``fence()`` for readbacks that need the host
+    value, not just completion: tree materialization, the periodic
+    stop check, prediction drains.  A bare ``jax.device_get`` on the
+    hot path is invisible to ``fence_count()`` (and flagged by the
+    ``sync-device-get`` lint rule); this is the sanctioned spelling.
+    Non-jax values pass through ``jax.device_get`` unchanged, so call
+    sites need no type checks.
+    """
+    global _FENCE_COUNT
+    import jax
+    _FENCE_COUNT += 1
+    return jax.device_get(value)
 
 
 class PhaseClock:

@@ -90,8 +90,7 @@ def build_bundle_arrays(train_data: TrainingData):
 # 18-30 MB degeneracy was root-caused to the tile planner's live-set
 # overshoot and fixed in ops/pallas_wave.py _tile_plan — post-mortem
 # in docs/FusedIteration.md.)
-from .autotune import (WAVE_VMEM_GATE as _WAVE_VMEM_GATE,
-                       _order_sensitive, resolve_wave_order,
+from .autotune import (_order_sensitive, resolve_wave_order,
                        resolve_wave_width)
 
 
@@ -810,7 +809,8 @@ class SerialTreeLearner:
         return tree, leaf_id
 
     def materialize(self, dev_tree: TreeArrays) -> Tree:
-        return materialize_tree(jax.device_get(dev_tree), self.train_data,
+        from ..obs.timers import fenced_get
+        return materialize_tree(fenced_get(dev_tree), self.train_data,
                                 self.num_leaves)
 
     # ------------------------------------------------------------ DART refit

@@ -50,6 +50,14 @@ try:
 except ImportError:                                    # pragma: no cover
     HAS_PALLAS = False
 
+# the one-hot tile + resident accumulator must fit here; the lanes
+# (row-chunk) axis of the block can shrink no further than the TPU's
+# 128-lane tile, so bin widths the floor cannot absorb are OUT of the
+# kernel's capacity (supports_bins) rather than silently over budget
+TILE_BUDGET = 6 * 2**20
+_MIN_ROW_CHUNK = 128
+
+
 def tile_shape(num_bins: int):
     """(F_BLK, ROW_CHUNK) sized so the (F_BLK*B, C) one-hot tile stays well
     under the ~16MB VMEM budget.  F_BLK stays at 8 (the TPU sublane
@@ -63,16 +71,36 @@ def tile_shape(num_bins: int):
     is bounded (F_BLK is fixed at 8), so it is subtracted from the tile
     budget rather than driving a separate regime.
 
+    The chunk floor is the 128-lane tile minimum, NOT a round perf
+    number: the old 512 floor quietly handed B=1024 a 16MB one-hot
+    (2.7x the budget) and B=4096 a 64MB one — the exact
+    floor-masks-the-budget bug class of the wave band post-mortem,
+    surfaced by the vmem lint pass (analysis/vmem.py) when it first ran.
+    Widths even the 128 floor cannot absorb fail ``supports_bins`` and
+    never reach the kernel (leaf_histogram_pallas falls back to onehot).
+
     Public: the kernel's VMEM geometry is part of the selection surface
     the autotuner (ops/autotune.py) and its probe harness reason about
     when instantiating kernel cells standalone."""
     f_blk = 8
     row_chunk = 2048
     resident = f_blk * num_bins * 3 * 4          # the out block, VMEM-held
-    budget = 6 * 2**20 - resident
-    while f_blk * num_bins * row_chunk * 4 > budget and row_chunk > 512:
+    budget = TILE_BUDGET - resident
+    while f_blk * num_bins * row_chunk * 4 > budget \
+            and row_chunk > _MIN_ROW_CHUNK:
         row_chunk //= 2
     return f_blk, row_chunk
+
+
+def supports_bins(num_bins: int) -> bool:
+    """True when some %128 row chunk keeps the kernel's live set
+    (one-hot tile + resident accumulator) within TILE_BUDGET.  At f32
+    with F_BLK=8 this tops out just under B=2048; beyond it the kernel
+    would need bin-axis blocking it does not have."""
+    f_blk = 8
+    resident = f_blk * num_bins * 3 * 4
+    return (f_blk * num_bins * _MIN_ROW_CHUNK * 4
+            <= TILE_BUDGET - resident)
 
 
 _tile_shape = tile_shape        # pre-v8 private name, kept importable
@@ -142,6 +170,17 @@ def leaf_histogram_pallas(binned, grad, hess, leaf_id, leaf, row_mult,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not supports_bins(num_bins):
+        # beyond the kernel's bin capacity even the minimum row chunk
+        # oversubscribes VMEM — serve the request from the XLA one-hot
+        # path instead of shipping an over-budget tile to the compiler
+        from ..utils.log import Log
+        from .histogram import leaf_histogram_onehot
+        Log.warning("pallas histogram: num_bins=%d exceeds the kernel's "
+                    "VMEM capacity (analysis/vmem.py vmem-hist-tile); "
+                    "falling back to onehot", num_bins)
+        return leaf_histogram_onehot(binned, grad, hess, leaf_id, leaf,
+                                     row_mult, num_bins=num_bins)
     n, f = binned.shape
     from .histogram import _weights
     w = _weights(jnp.asarray(grad, jnp.float32),

@@ -24,7 +24,7 @@ processes replaces machine_list_file/port handshakes (linkers_socket.cpp).
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,7 @@ except ImportError:  # older jax
 
 from ..io.dataset import TrainingData
 from ..ops.grow import make_grow_fn
-from ..ops.learner import SerialTreeLearner, build_split_params
+from ..ops.learner import SerialTreeLearner
 from ..ops.wave import WAVE_ONLY_MODES
 from ..ops.split_finder import FeatureMeta
 from ..utils.config import Config

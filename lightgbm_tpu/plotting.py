@@ -6,7 +6,6 @@ import numpy as np
 
 from .basic import Booster
 from .sklearn import LGBMModel
-from .utils.log import LightGBMError
 
 
 def _check_not_tuple_of_2_elements(obj, obj_name="obj"):

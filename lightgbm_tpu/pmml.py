@@ -7,7 +7,7 @@ lessOrEqual/greaterThan).
 """
 from __future__ import annotations
 
-from typing import List, TextIO
+from typing import List
 
 from .models.gbdt import GBDT
 from .models.tree import Tree

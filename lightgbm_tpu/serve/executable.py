@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from ..obs.compile import arg_signature, render_signature
 from ..obs.events import NULL_OBSERVER
 from ..obs.metrics import REGISTRY
+from ..obs.timers import fenced_get
 from ..ops import predict as dev_predict
 from ..utils.config import _TRUE_SET
 from ..utils.log import Log
@@ -346,7 +347,7 @@ class PredictExecutableCache:
         else:
             Vd = jax.device_put(V, self.devices[0])
             Dd = jax.device_put(D, self.devices[0])
-        out = np.asarray(jax.device_get(exe(self._dev, Vd, Dd))[:n],
+        out = np.asarray(fenced_get(exe(self._dev, Vd, Dd))[:n],
                          np.float64)
         t2 = time.perf_counter()
         if convert and self._conv == "host":

@@ -327,7 +327,13 @@ def fmt_default(typ, val):
     return str(val)
 
 
-def main():
+def render():
+    """The full Parameters.md text from the live registry.
+
+    Split out of main() so the doc-freshness consumers — the
+    ``params-doc-stale`` lint rule (lightgbm_tpu/analysis/
+    config_coherence.py) and the CI regen-diff gate — can compare
+    against a fresh render without touching the file."""
     fields = dict(Config._FIELDS)
     # parameters accepted via PARAMETER_SET but handled outside the typed
     # field table (config-file plumbing, column-role strings, ...)
@@ -365,13 +371,18 @@ def main():
             out.append("| %s | %s | %s | %s |"
                        % (k, typ, fmt_default(typ, dv),
                           ", ".join(aliases_of(k))))
+    return "\n".join(out) + "\n"
+
+
+def main():
+    text = render()
     path = (sys.argv[1] if len(sys.argv) > 1
             else os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "docs", "Parameters.md"))
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
-        f.write("\n".join(out) + "\n")
-    print("wrote %s (%d keys)" % (path, len(fields)))
+        f.write(text)
+    print("wrote %s (%d lines)" % (path, text.count("\n")))
 
 
 if __name__ == "__main__":

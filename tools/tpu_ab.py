@@ -9,7 +9,6 @@ tools/AB_RESULTS.md.
 """
 import datetime
 import os
-import subprocess
 import sys
 import time
 
