@@ -670,6 +670,110 @@ def dry():
                       "path": obs_path}))
 
 
+def mp_bench(world):
+    """Multi-host weak-scaling measurement (--mp N): a 1-rank baseline
+    and an N-rank run of the SAME per-rank shape through the subprocess
+    pod launcher (parallel/launch.py), each rank a real process with its
+    own ``jax.distributed`` world.
+
+    Prints ONE JSON line with rows/sec/chip at N ranks and the
+    weak-scaling efficiency (rate-per-chip at N over rate-per-chip at 1),
+    and lands both as a ``scaling`` event in an obs timeline ingested
+    into the cross-run ledger — world_size is part of the ledger cell
+    key, so ``obs trend --check`` gates N-rank history only against
+    N-rank history.  Where jaxlib lacks cross-process CPU collectives
+    the line carries {"status": "mp_unsupported"} and the exit is clean:
+    absence of a pod is not a benchmark failure.
+    """
+    from lightgbm_tpu.parallel.launch import (MultiprocessUnsupported,
+                                              run_ranks_subprocess)
+
+    rows_per_rank = int(os.environ.get("BENCH_MP_ROWS", 4096))
+    cols = int(os.environ.get("BENCH_MP_COLS", 16))
+    rounds = int(os.environ.get("BENCH_MP_ROUNDS", 8))
+    local_devices = int(os.environ.get("BENCH_MP_LOCAL_DEVICES", 1))
+    timeout = float(os.environ.get("BENCH_MP_TIMEOUT", 540.0))
+    spec = "lightgbm_tpu.parallel.worker:train_worker"
+    metric = "rows_per_sec_per_chip_mp%d_%drx%dc" % (world, rows_per_rank,
+                                                     cols)
+
+    def run(size):
+        # weak scaling: rows PER RANK stay fixed, total rows grow with
+        # the world — the worker slices rows/size per rank
+        payload = {"rows": rows_per_rank * size, "cols": cols,
+                   "num_rounds": rounds, "seed": 11,
+                   "params": {"tree_learner": "data"}}
+        results = run_ranks_subprocess(size, spec, payload,
+                                       local_devices=local_devices,
+                                       timeout=timeout)
+        # the slowest rank bounds the wave; every rank trains the same
+        # global trees so iters/rows agree by construction
+        slowest = max(float(r["train_s"]) for r in results)
+        total_rows = sum(int(r["num_data"]) for r in results)
+        rate = total_rows * rounds / max(slowest, 1e-9)
+        return rate / (size * local_devices), results
+
+    try:
+        rpc1, _ = run(1)
+        rpcN, resN = run(world)
+    except MultiprocessUnsupported as e:
+        print(json.dumps({"metric": metric, "value": None,
+                          "unit": "rows/sec/chip", "vs_baseline": None,
+                          "status": "mp_unsupported", "detail": str(e)}))
+        return
+    eff = rpcN / max(rpc1, 1e-9)
+
+    # land the measurement in the ledger as an N-rank cell: scaling
+    # events are the one metrics source (obs/ledger.py
+    # metrics_from_events), world_size rides the run_header
+    from lightgbm_tpu.obs.events import RunObserver
+    from lightgbm_tpu.obs.ledger import Ledger, default_ledger_dir
+    obs_path = "/tmp/bench_mp_obs_%d.jsonl" % os.getpid()
+    try:
+        os.unlink(obs_path)
+    except OSError:
+        pass
+    obs = RunObserver(events_path=obs_path, rank=0, world_size=world)
+    obs.run_header(backend="cpu", devices=[],
+                   params={"rows_per_rank": rows_per_rank, "cols": cols,
+                           "num_rounds": rounds},
+                   context={"tool": "bench_mp"})
+    obs.event("scaling", world_size=world,
+              rows_per_sec_per_chip=round(rpcN, 3),
+              efficiency=round(eff, 4),
+              chips=world * local_devices,
+              rows=sum(int(r["num_data"]) for r in resN),
+              iters=rounds, mode="weak",
+              baseline_rows_per_sec=round(rpc1, 3),
+              rows_per_sec=round(rpcN * world * local_devices, 3))
+    obs.close(status="ok")
+    ledger_dir = default_ledger_dir()
+    if ledger_dir:
+        try:
+            Ledger(ledger_dir).ingest_timeline(
+                obs_path, suite="bench_mp",
+                shape="%drx%dc" % (rows_per_rank, cols))
+        except Exception as e:
+            print("bench: mp ledger ingest failed (%s)" % e,
+                  file=sys.stderr, flush=True)
+
+    digests = sorted({r["digest"] for r in resN})
+    print(json.dumps({
+        "metric": metric,
+        "value": round(rpcN, 3),
+        "unit": "rows/sec/chip",
+        "vs_baseline": None,
+        "world_size": world,
+        "chips": world * local_devices,
+        "rows_per_sec_per_chip_1rank": round(rpc1, 3),
+        "weak_scaling_eff": round(eff, 4),
+        # every rank must build the SAME global trees — the pod's
+        # correctness invariant rides along with the perf number
+        "digests_agree": len(digests) == 1,
+        "obs_path": obs_path,
+    }))
+
+
 def construct_bench():
     """Parallel two-pass binning speedup (--construct): streamed
     construction of the flagship matrix, serial vs all-core worker pool.
@@ -731,5 +835,7 @@ if __name__ == "__main__":
         dry()
     elif len(sys.argv) > 1 and sys.argv[1] == "--construct":
         construct_bench()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--mp":
+        mp_bench(int(sys.argv[2]) if len(sys.argv) > 2 else 2)
     else:
         main()

@@ -31,12 +31,16 @@ PACKAGE_DIRNAME = "lightgbm_tpu"
 
 # hot-path scope of the host-sync pass: modules where an implicit
 # device->host sync stalls the async dispatch pipeline (training inner
-# loop, fused iteration, serving data plane).  obs/ is deliberately OUT
-# of scope — fencing is its job.
+# loop, fused iteration, serving data plane, and the multi-host comm /
+# mesh layer — a stray sync there stalls EVERY rank at the next
+# collective, not just the offender).  obs/ is deliberately OUT of
+# scope — fencing is its job.
 HOT_PATH_PREFIXES = (
     "lightgbm_tpu/ops/",
     "lightgbm_tpu/models/gbdt.py",
     "lightgbm_tpu/serve/",
+    "lightgbm_tpu/parallel/comm.py",
+    "lightgbm_tpu/parallel/mesh.py",
 )
 
 

@@ -429,12 +429,17 @@ class Dataset:
         return self
 
     @classmethod
-    def from_binned(cls, path: str, params=None) -> "Dataset":
+    def from_binned(cls, path: str, params=None, comm=None,
+                    row_range=None) -> "Dataset":
         """Open a pre-binned dataset directory written by save_binned()
         or the streaming `ooc_binned_dir` ingest; shards stay mmap-backed
-        and page to the device without a host-side bin matrix."""
+        and page to the device without a host-side bin matrix.  With a
+        multi-process ``comm`` (or an explicit ``row_range``) the open is
+        rank-sharded: this process maps only its own row range and the
+        dataset trains over the global mesh (docs/Distributed.md)."""
         ds = cls(path, params=params)
-        ds._handle = TrainingData.from_binned(path)
+        ds._handle = TrainingData.from_binned(path, comm=comm,
+                                              row_range=row_range)
         return ds
 
 
@@ -442,7 +447,8 @@ class _InnerPredictor:
     """Continued-training score provider (basic.py:293-543 analog)."""
 
     def __init__(self, booster: Optional["Booster"] = None,
-                 model_file: Optional[str] = None):
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
         if booster is not None:
             self.gbdt = booster._gbdt
         elif model_file is not None:
@@ -450,8 +456,14 @@ class _InnerPredictor:
             self.gbdt = GBDT(cfg)
             with open(model_file) as f:
                 self.gbdt.load_model_from_string(f.read())
+        elif model_str is not None:
+            # checkpoint resume (models/checkpoint.py): the model text
+            # arrives in-memory, never via a file of its own
+            cfg = Config()
+            self.gbdt = GBDT(cfg)
+            self.gbdt.load_model_from_string(model_str)
         else:
-            raise LightGBMError("Need booster or model_file")
+            raise LightGBMError("Need booster, model_file or model_str")
 
     def predict_raw_for_init(self, features: np.ndarray) -> np.ndarray:
         # exact f64 host path: continued-training init scores feed the

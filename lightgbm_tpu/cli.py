@@ -8,6 +8,7 @@ Snapshots every ``snapshot_freq`` iterations (application.cpp:237-241).
 """
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List
 
@@ -51,10 +52,24 @@ def parse_cli_params(argv: List[str]) -> Dict[str, str]:
 def run_train(cfg: Config) -> None:
     if not cfg.data:
         Log.fatal("No training data, application quit")
+    # elastic checkpoint/resume (models/checkpoint.py) on the CLI
+    # surface too — same contract as engine.train: a compatible
+    # checkpoint in checkpoint_dir seeds the model and only the
+    # remaining rounds run; an explicit input_model wins.  Peeked before
+    # the data load because continuing needs the raw rows kept.
+    ck_dir = str(cfg.raw.get("checkpoint_dir", "") or "")
+    ck_every = int(cfg.raw.get("checkpoint_every", 0) or 0)
+    resume_ck = None
+    if ck_dir and not cfg.input_model:
+        from .models import checkpoint as ckpt_mod
+        resume_ck = ckpt_mod.load_checkpoint(ck_dir)
+        if resume_ck is not None:
+            ckpt_mod.check_resumable(resume_ck, dict(cfg.raw))
     Log.info("Loading train data...")
     # keep raw rows when continuing: loaded models predict on raw values
-    train_td = TrainingData.from_file(cfg.data, cfg,
-                                      keep_raw=bool(cfg.input_model))
+    train_td = TrainingData.from_file(
+        cfg.data, cfg,
+        keep_raw=bool(cfg.input_model) or resume_ck is not None)
     if getattr(train_td, "_binned_reader", None) is not None:
         Log.info("Train data is pre-binned (mmap-backed, %d shard(s), "
                  "zero re-binning)", train_td._binned_reader.num_shards)
@@ -76,6 +91,15 @@ def run_train(cfg: Config) -> None:
         Log.info("Continued training from %s", cfg.input_model)
         booster.load_model_from_string(base)
         booster.reset_training_data(cfg, train_td, objective, training_metrics)
+    rounds_done = 0
+    if resume_ck is not None:
+        booster.load_model_from_string(resume_ck["model"])
+        booster.reset_training_data(cfg, train_td, objective,
+                                    training_metrics)
+        rounds_done = int(resume_ck["iteration"])
+        Log.info("Resuming from checkpoint %s: %d round(s) done, "
+                 "%d remain", ck_dir, rounds_done,
+                 max(0, cfg.num_iterations - rounds_done))
     for i, vf in enumerate(cfg.valid_data or []):
         Log.info("Loading validation data %d...", i + 1)
         valid_td = TrainingData.from_file(vf, cfg, reference=train_td)
@@ -98,7 +122,7 @@ def run_train(cfg: Config) -> None:
         Log.info("jax.profiler trace -> %s", profile_dir)
     finished = False
     try:
-        for it in range(cfg.num_iterations):
+        for it in range(rounds_done, cfg.num_iterations):
             t0 = time.time()
             stop = booster.train_one_iter(None, None, True)
             Log.info("%f seconds elapsed, finished iteration %d",
@@ -106,6 +130,14 @@ def run_train(cfg: Config) -> None:
             if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
                 booster.save_model_to_file("%s.snapshot_iter_%d"
                                            % (cfg.output_model, it + 1))
+            if ck_every > 0 and ck_dir and (it + 1) % ck_every == 0:
+                from .models import checkpoint as ckpt_mod
+                path = ckpt_mod.save_checkpoint(ck_dir, booster, it + 1,
+                                                dict(cfg.raw))
+                if booster._obs.enabled:
+                    booster._obs.event(
+                        "checkpoint", it=it + 1, path=path,
+                        bytes=int(os.path.getsize(path)), world_size=1)
             if stop:
                 break
         finished = True

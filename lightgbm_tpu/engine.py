@@ -30,14 +30,38 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     if fobj is not None:
         params["objective"] = "none"
 
+    # elastic checkpoint/resume (models/checkpoint.py): a compatible
+    # checkpoint in checkpoint_dir resumes the run — the model text seeds
+    # scores through the init_model machinery and only the REMAINING
+    # rounds run.  An explicit init_model wins over any checkpoint.
+    ck_dir = str(params.get("checkpoint_dir", "") or "")
+    ck_every = int(params.get("checkpoint_every", 0) or 0)
+    resumed_ck = None
+    if ck_dir and init_model is None:
+        from .models import checkpoint as ckpt_mod
+        resumed_ck = ckpt_mod.load_checkpoint(ck_dir)
+        if resumed_ck is not None:
+            ckpt_mod.check_resumable(resumed_ck, params)
+
     predictor = None
     if init_model is not None:
         if isinstance(init_model, str):
             predictor = _InnerPredictor(model_file=init_model)
         elif isinstance(init_model, Booster):
             predictor = _InnerPredictor(booster=init_model)
+    elif resumed_ck is not None:
+        predictor = _InnerPredictor(model_str=resumed_ck["model"])
+        done = int(resumed_ck["iteration"])
+        num_boost_round = max(0, num_boost_round - done)
+        Log.info("Resuming from checkpoint %s: %d round(s) done, "
+                 "%d remain", ck_dir, done, num_boost_round)
     init_iteration = (len(predictor.gbdt.models) // max(predictor.gbdt.num_tree_per_iteration, 1)
                       if predictor is not None else 0)
+    # total completed rounds from the ORIGINAL zero — a twice-resumed
+    # run keeps counting where the first run started (the model-count
+    # derived init_iteration can be off by the boost_from_average stub)
+    rounds_done_base = (int(resumed_ck["iteration"]) if resumed_ck
+                        is not None else init_iteration)
 
     if isinstance(train_set, str):
         # pre-binned dataset directory (io/binned_format.py): open it
@@ -59,6 +83,20 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     if fobj is not None:
         params["objective"] = "none"
     booster = Booster(params=params, train_set=train_set)
+    if resumed_ck is not None:
+        # elastic shrink: record the mesh transition when the resumed
+        # world differs from the one that wrote the checkpoint (schema 12
+        # mesh_shrink — the flight-record anchor for `obs explain`)
+        _comm = getattr(booster._gbdt.train_data, "_comm", None)
+        _world = int(getattr(_comm, "size", 1) or 1)
+        _ck_world = int(resumed_ck.get("world_size", 1) or 1)
+        _obs = booster._gbdt._obs
+        if _ck_world != _world and _obs.enabled:
+            from .models import checkpoint as ckpt_mod
+            _obs.event("mesh_shrink", world_size_from=_ck_world,
+                       world_size_to=_world,
+                       it=int(resumed_ck["iteration"]), reason="resume",
+                       checkpoint=ckpt_mod.checkpoint_path(ck_dir))
     is_valid_contain_train = False
     train_data_name = "training"
     reduced_valid_sets = []
@@ -107,6 +145,24 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                                             end_iteration=init_iteration + num_boost_round,
                                             evaluation_result_list=None))
             booster.update(fobj=fobj)
+
+            if ck_every > 0 and ck_dir:
+                total_rounds = rounds_done_base + (i - init_iteration) + 1
+                comm = getattr(booster._gbdt.train_data, "_comm", None)
+                world = int(getattr(comm, "size", 1) or 1)
+                if total_rounds % ck_every == 0 and \
+                        int(getattr(comm, "rank", 0) or 0) == 0:
+                    from .models import checkpoint as ckpt_mod
+                    path = ckpt_mod.save_checkpoint(
+                        ck_dir, booster._gbdt, total_rounds, params,
+                        world_size=world)
+                    obs = booster._gbdt._obs
+                    if obs.enabled:
+                        import os as _os
+                        obs.event("checkpoint", it=total_rounds,
+                                  path=path,
+                                  bytes=int(_os.path.getsize(path)),
+                                  world_size=world)
 
             evaluation_result_list = []
             if valid_sets is not None or feval is not None:

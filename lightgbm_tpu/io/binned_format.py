@@ -202,22 +202,69 @@ class BinnedReader:
 
     ``shard(i)`` returns an np.memmap (zero host copy until pages are
     touched); ``iter_shards`` drives the paged device upload.
+
+    ``verify`` grades the integrity check: ``True`` streams every shard's
+    CRC at open (the original full-scan flag, kept for `verify=True`
+    callers), ``"lazy"`` (the default) defers each shard's CRC to its
+    first map — so a pod rank that opens 1/64th of the rows never reads
+    the other 63/64ths — and ``False`` skips CRCs entirely.  Size checks
+    stay at open time but cover only the shards this reader can reach.
+
+    ``row_range=(start, stop)`` scopes the reader to a row interval of
+    the shard table (multi-host sharded ingest, io/dataset.py
+    ``from_binned(comm=...)``): validation, ``rows()`` and the mapped-
+    shard accounting all restrict to overlapping shards.
+    ``mapped_shards`` records every shard index actually memmapped — the
+    "no rank touches foreign rows" assertion reads it directly.
     """
 
-    def __init__(self, path: str, verify: bool = True):
+    def __init__(self, path: str, verify="lazy", row_range=None):
         self.path = str(path)
         self.header = _read_header(self.path)
         self.dtype = np.dtype(self.header["dtype"])
         self.num_columns = int(self.header["num_columns"])
         self.num_data = int(self.header["num_data"])
         self.shards = self.header["shards"]
+        starts = [0]
+        for s in self.shards:
+            starts.append(starts[-1] + int(s["rows"]))
+        self._starts = starts               # len num_shards + 1
+        if starts[-1] != self.num_data:
+            raise BinnedFormatError(
+                "binned dataset '%s' shard table sums to %d rows but the "
+                "header says num_data=%d" % (self.path, starts[-1],
+                                             self.num_data))
+        if row_range is None:
+            self.row_range = (0, self.num_data)
+        else:
+            lo, hi = int(row_range[0]), int(row_range[1])
+            if not (0 <= lo <= hi <= self.num_data):
+                raise BinnedFormatError(
+                    "row_range [%d, %d) out of bounds for %d rows in '%s'"
+                    % (lo, hi, self.num_data, self.path))
+            self.row_range = (lo, hi)
+        self.mapped_shards = set()
+        self._crc_ok = set()
+        self._verify = verify
         self._check_sizes()
-        if verify:
+        if verify is True:
             self.verify_checksums()
+
+    def shards_for_range(self, start, stop):
+        """Indices of shards overlapping rows [start, stop)."""
+        return [i for i in range(len(self.shards))
+                if self._starts[i] < stop and self._starts[i + 1] > start
+                and int(self.shards[i]["rows"]) > 0]
+
+    @property
+    def active_shards(self):
+        """Shard indices reachable under this reader's row_range."""
+        return self.shards_for_range(*self.row_range)
 
     def _check_sizes(self):
         itemsize = self.dtype.itemsize
-        for s in self.shards:
+        for i in self.active_shards:
+            s = self.shards[i]
             fpath = os.path.join(self.path, s["file"])
             if not os.path.isfile(fpath):
                 raise BinnedFormatError(
@@ -232,14 +279,21 @@ class BinnedReader:
                     % (s["file"], got, want, s["rows"], self.num_columns,
                        self.dtype.name))
 
+    def _check_crc(self, i: int):
+        if i in self._crc_ok:
+            return
+        s = self.shards[i]
+        crc = _file_crc(os.path.join(self.path, s["file"]))
+        if crc != int(s["crc32"]):
+            raise BinnedFormatError(
+                "shard %s checksum mismatch (got %08x, header says "
+                "%08x) — the binned dataset at '%s' is corrupt"
+                % (s["file"], crc, int(s["crc32"]), self.path))
+        self._crc_ok.add(i)
+
     def verify_checksums(self):
-        for s in self.shards:
-            crc = _file_crc(os.path.join(self.path, s["file"]))
-            if crc != int(s["crc32"]):
-                raise BinnedFormatError(
-                    "shard %s checksum mismatch (got %08x, header says "
-                    "%08x) — the binned dataset at '%s' is corrupt"
-                    % (s["file"], crc, int(s["crc32"]), self.path))
+        for i in range(len(self.shards)):
+            self._check_crc(i)
 
     @property
     def num_shards(self) -> int:
@@ -249,9 +303,34 @@ class BinnedReader:
         s = self.shards[i]
         if int(s["rows"]) == 0 or self.num_columns == 0:
             return np.zeros((int(s["rows"]), self.num_columns), self.dtype)
+        if self._verify == "lazy":
+            self._check_crc(i)
+        self.mapped_shards.add(i)
         return np.memmap(os.path.join(self.path, s["file"]),
                          dtype=self.dtype, mode="r", order="F",
                          shape=(int(s["rows"]), self.num_columns))
+
+    def rows(self, start, stop) -> np.ndarray:
+        """Bin-matrix rows [start, stop), mapping ONLY the overlapping
+        shards — the rank-sharded ingest path.  A range inside one shard
+        stays a zero-copy memmap slice."""
+        start, stop = int(start), int(stop)
+        if not (0 <= start <= stop <= self.num_data):
+            raise BinnedFormatError(
+                "rows [%d, %d) out of bounds for %d rows"
+                % (start, stop, self.num_data))
+        idx = self.shards_for_range(start, stop)
+        if not idx:
+            return np.zeros((stop - start, self.num_columns), self.dtype)
+        parts = []
+        for i in idx:
+            view = self.shard(i)
+            lo = max(start - self._starts[i], 0)
+            hi = min(stop - self._starts[i], view.shape[0])
+            parts.append(view[lo:hi])
+        if len(parts) == 1:
+            return parts[0]
+        return np.concatenate([np.asarray(p) for p in parts], axis=0)
 
     def iter_shards(self):
         start = 0
@@ -259,6 +338,18 @@ class BinnedReader:
             view = self.shard(i)
             yield start, view
             start += view.shape[0]
+
+    def iter_rows(self, start=None, stop=None):
+        """Yield ``(offset_within_range, view_slice)`` paging ONLY the
+        shards overlapping ``[start, stop)`` (defaults: this reader's
+        ``row_range``) — the sharded-ingest analog of ``iter_shards``."""
+        lo = self.row_range[0] if start is None else int(start)
+        hi = self.row_range[1] if stop is None else int(stop)
+        for i in self.shards_for_range(lo, hi):
+            view = self.shard(i)
+            a = max(lo - self._starts[i], 0)
+            b = min(hi - self._starts[i], view.shape[0])
+            yield self._starts[i] + a - lo, view[a:b]
 
     def matrix(self) -> np.ndarray:
         """Full bin matrix.  Single-shard datasets stay a zero-copy memmap;
@@ -271,7 +362,10 @@ class BinnedReader:
         return np.concatenate([self.shard(i)
                                for i in range(len(self.shards))], axis=0)
 
-    def load_metadata_array(self, name: str):
+    def load_metadata_array(self, name: str, mmap: bool = False):
+        """Sidecar array, or None.  ``mmap=True`` opens it as a read-only
+        memmap so a rank-sharded caller can copy out just its row slice
+        instead of paging the whole pod's labels."""
         fname = self.header.get(name)
         if not fname:
             return None
@@ -280,7 +374,8 @@ class BinnedReader:
             raise BinnedFormatError(
                 "binned dataset '%s' header references %s but the file is "
                 "missing" % (self.path, fname))
-        return np.load(fpath, allow_pickle=False)
+        return np.load(fpath, allow_pickle=False,
+                       mmap_mode="r" if mmap else None)
 
 
 def save_training_data(td, path: str, shard_rows: int = 1 << 20) -> dict:

@@ -75,7 +75,14 @@ class TrainingData:
     @property
     def binned(self) -> Optional[np.ndarray]:
         if self._binned is None and self._binned_reader is not None:
-            self._binned = self._binned_reader.matrix()
+            r = self._binned_reader
+            lo, hi = r.row_range
+            if (lo, hi) == (0, r.num_data):
+                self._binned = r.matrix()
+            else:
+                # rank-sharded open: materialize ONLY this rank's rows,
+                # mapping only the shards that overlap them
+                self._binned = np.ascontiguousarray(r.rows(lo, hi))
         return self._binned
 
     @binned.setter
@@ -811,18 +818,41 @@ class TrainingData:
         return _bf.is_binned_dir(path)
 
     @classmethod
-    def from_binned(cls, path: str, verify: bool = True) -> "TrainingData":
+    def from_binned(cls, path: str, verify=None, comm=None,
+                    row_range=None) -> "TrainingData":
         """Open a pre-binned dataset directory: shards stay mmap-backed
         (no bin matrix materialized until something asks for it; the
-        learner pages shards straight to the device)."""
+        learner pages shards straight to the device).
+
+        ``comm``: optional parallel.comm.HostComm for multi-host sharded
+        ingest — each rank opens only its balanced row-range of the
+        shard table (``row_range`` overrides the balance), so peak
+        per-host RSS stays O(rank rows).  Bin mappers come verbatim from
+        the shared header, so every rank freezes bit-identical binning
+        with zero collective rounds.
+
+        ``verify``: ``None`` picks the right default — a full CRC scan
+        for whole-dataset opens (the original ``verify=True`` contract),
+        lazy per-mapped-shard CRCs for rank-sharded opens (a rank
+        reading 1/64th of the rows must not stream the other 63/64ths).
+        Pass ``True``/``"lazy"``/``False`` to force a mode."""
         from . import binned_format as _bf
         from .streaming import _peak_rss_bytes
         rss0 = _peak_rss_bytes()
         t0 = _time.time()
-        reader = _bf.BinnedReader(path, verify=verify)
+        sharded = (comm is not None and comm.size > 1) \
+            or row_range is not None
+        if verify is None:
+            verify = "lazy" if sharded else True
+        if comm is not None and comm.size > 1 and row_range is None:
+            total = int(_bf._read_header(str(path))["num_data"])
+            row_range = (comm.rank * total // comm.size,
+                         (comm.rank + 1) * total // comm.size)
+        reader = _bf.BinnedReader(path, verify=verify, row_range=row_range)
         h = reader.header
         self = cls()
-        self.num_data = int(h["num_data"])
+        lo, hi = reader.row_range
+        self.num_data = hi - lo
         self.num_total_features = int(h["num_total_features"])
         self.used_feature_idx = list(h["used_feature_idx"])
         self.real_to_inner = {r: i for i, r in
@@ -837,21 +867,43 @@ class TrainingData:
             self.bundle = build_layout(groups, self.num_bin_arr,
                                        self.default_bin_arr)
         self._binned_reader = reader
+        self._comm = comm if (comm is not None and comm.size > 1) else None
         self.metadata = Metadata(self.num_data)
-        label = reader.load_metadata_array("label")
+
+        def _local(arr):
+            """This rank's row slice of a per-row sidecar, copied out of
+            the memmap so resident bytes stay O(rank rows)."""
+            if arr is None or not sharded:
+                return arr
+            if arr.shape[0] == hi - lo:     # already rank-local
+                return np.asarray(arr)
+            return np.array(arr[lo:hi])
+
+        label = reader.load_metadata_array("label", mmap=sharded)
         if label is not None:
-            self.metadata.label = label
-        self.metadata.weights = reader.load_metadata_array("weights")
-        self.metadata.query_boundaries = \
-            reader.load_metadata_array("query_boundaries")
-        self.metadata.init_score = reader.load_metadata_array("init_score")
+            self.metadata.label = _local(label)
+        self.metadata.weights = _local(
+            reader.load_metadata_array("weights", mmap=sharded))
+        qb = reader.load_metadata_array("query_boundaries")
+        if qb is not None and sharded:
+            # query groups straddle row-range cuts; pre-partition ranking
+            # data per rank instead (the reference's pre_partition path)
+            Log.fatal("rank-sharded from_binned does not support ranking "
+                      "(query_boundaries) datasets — pre-partition them "
+                      "per rank")
+        self.metadata.query_boundaries = qb
+        self.metadata.init_score = _local(
+            reader.load_metadata_array("init_score", mmap=sharded))
         # sketch_s and bin_s stay 0: opening the format does ZERO
         # re-binning work (the CI ooc-smoke gate asserts exactly this)
+        extra = {"load_s": round(_time.time() - t0, 6)}
+        if sharded:
+            extra["row_range"] = [int(lo), int(hi)]
+            extra["world_size"] = int(comm.size) if comm is not None else 1
         self._note_construct_stats("binned", rows=self.num_data,
                                    chunks=reader.num_shards, sketch_s=0.0,
                                    bin_s=0.0, write_s=0.0, workers=1,
-                                   rss_before=rss0,
-                                   load_s=round(_time.time() - t0, 6))
+                                   rss_before=rss0, **extra)
         return self
 
 

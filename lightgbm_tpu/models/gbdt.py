@@ -629,6 +629,13 @@ class GBDT:
                     oc.enter()
                     dev_tree, leaf_id = self.learner.train_device(
                         g_dev[tid], h_dev[tid], self.row_mult)
+                    if getattr(self.learner, "_nproc", 1) > 1:
+                        # multi-host pod: the grow program psums
+                        # histograms over the global mesh and hands back
+                        # a GLOBAL row->leaf map; scores here stay
+                        # rank-LOCAL, so take this process's rows (an
+                        # addressable-shard read, no collective)
+                        leaf_id = self.learner.local_rows(leaf_id)
                     # "grow" = the histogram+split+partition XLA program
                     # (one jitted entry; finer decomposition needs a
                     # profiler window — see docs/Observability.md)
@@ -713,6 +720,17 @@ class GBDT:
             if is_eval or (self.iter % 16 == 0):
                 should_continue = any(int(nl) > 1
                                       for nl in fenced_get(num_leaves_this_iter))
+                comm = self._dist_comm()
+                if comm is not None:
+                    # pod-wide stop vote.  Trees are bit-identical across
+                    # ranks (split search runs on psum'd histograms), so
+                    # ranks normally agree — the vote pins the invariant:
+                    # no rank may stop alone and leave the others hanging
+                    # in the next wave's psum.  Cadence (is_eval or
+                    # iter%16) is config-derived, hence collective-aligned.
+                    from ..parallel.comm import vote_stop
+                    should_continue = not vote_stop(comm,
+                                                    not should_continue)
         else:
             should_continue = False
         if not should_continue:
@@ -818,9 +836,46 @@ class GBDT:
         self.iter -= 1
 
     # ------------------------------------------------------------------ eval
+    def _dist_comm(self):
+        """The training dataset's multi-process comm, or None.  Present
+        only for rank-sharded datasets (io/dataset.py from_binned /
+        from_matrix with a comm) — the signal that metric values are
+        partial sums over local rows and stop decisions need a vote."""
+        comm = (getattr(self.train_data, "_comm", None)
+                if self.train_data is not None else None)
+        if comm is not None and getattr(comm, "size", 1) > 1 \
+                and not getattr(comm, "closed", False):
+            return comm
+        return None
+
+    def _reduce_scores(self, scores, num_local_rows):
+        """Row-weighted cross-rank mean of per-metric scores.  Metrics
+        evaluate over the rank's LOCAL score shard; the weighted mean by
+        local row count recovers the global row-average every rank then
+        agrees on — which keeps the early-stopping bookkeeping (and its
+        model pop-back) bit-identical across the pod.  Routes through
+        the host comm (parallel/comm.py), so it lands in the
+        host_collective observability stream with a seq number."""
+        comm = self._dist_comm()
+        if comm is None:
+            return scores
+        from ..parallel.comm import reduce_metrics
+        red = reduce_metrics(
+            comm, {str(i): float(s) for i, s in enumerate(scores)},
+            weight=float(num_local_rows))
+        return [red[str(i)] for i in range(len(scores))]
+
     def eval_and_check_early_stopping(self) -> bool:
         best_msg = self.output_metric(self.iter)
         met = bool(best_msg)
+        comm = self._dist_comm()
+        if comm is not None:
+            # unanimous vote: with reduced metrics every rank already
+            # computed the same answer, so this is a divergence guard —
+            # a rank that disagrees (e.g. a stale shard) cannot keep
+            # training against ranks that popped models back
+            from ..parallel.comm import vote_stop
+            met = vote_stop(comm, met)
         if met:
             Log.info("Early stopping at iteration %d, the best iteration round is %d",
                      self.iter, self.iter - self.early_stopping_round)
@@ -843,7 +898,9 @@ class GBDT:
         eval_results = [] if self._obs.enabled else None
         if need_output:
             for m in self.training_metrics:
-                scores = m.eval(self.train_score, self.objective)
+                scores = self._reduce_scores(
+                    m.eval(self.train_score, self.objective),
+                    self.num_data)
                 for name, s in zip(m.get_names(), scores):
                     line = "Iteration:%d, training %s : %g" % (it, name, s)
                     Log.info(line)
@@ -856,7 +913,9 @@ class GBDT:
         if need_output or self.early_stopping_round > 0:
             for i in range(len(self.valid_metrics)):
                 for j, m in enumerate(self.valid_metrics[i]):
-                    test_scores = m.eval(self.valid_score_host(i), self.objective)
+                    test_scores = self._reduce_scores(
+                        m.eval(self.valid_score_host(i), self.objective),
+                        self.valid_data[i].num_data)
                     for name, s in zip(m.get_names(), test_scores):
                         line = "Iteration:%d, valid_%d %s : %g" % (it, i + 1, name, s)
                         if need_output:

@@ -100,18 +100,20 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
 # 7 (no autotune/band-escape events), 8 (no dataset_construct),
-# 9 (no run_header provenance) and 10 (no host_orchestration_s iter
+# 9 (no run_header provenance), 10 (no host_orchestration_s iter
 # field — schema 11 adds the host-glue seconds between device program
-# submissions, models/gbdt.py OrchestrationClock) timelines still parse.
-# wave_band_escape stays accepted for old timelines even though nothing
-# emits it anymore (the band prior died in PR-11; ops/pallas_wave.py
-# tile planner post-mortem).
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+# submissions, models/gbdt.py OrchestrationClock) and 11 (no pod
+# scale-out events — schema 12 adds scaling / mesh_shrink / checkpoint
+# and the sharded-ingest dataset_construct fields) timelines still
+# parse.  wave_band_escape stays accepted for old timelines even though
+# nothing emits it anymore (the band prior died in PR-11;
+# ops/pallas_wave.py tile planner post-mortem).
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -166,6 +168,13 @@ _REQUIRED = {
     # width and the host RSS watermark; bench_compare gates construct_s
     "dataset_construct": ("rows", "chunks", "sketch_s", "bin_s",
                           "write_s", "peak_rss_bytes", "workers"),
+    # schema 12 (parallel/ + bench.py --mp + engine.py): pod scale-out —
+    # one scaling summary per measured world size (the weak-scaling
+    # ledger cells, obs/ledger.py), one mesh_shrink per elastic
+    # shrink-and-resume, one checkpoint per compact booster save
+    "scaling": ("world_size", "rows_per_sec_per_chip", "efficiency"),
+    "mesh_shrink": ("world_size_from", "world_size_to", "it"),
+    "checkpoint": ("it",),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -229,7 +238,14 @@ _OPTIONAL = {
     # the old-timeline renderer in obs/query.py
     "wave_band_escape": ("band_lo_mb", "band_hi_mb", "block_mb", "ncols",
                          "bin_pad"),
-    "dataset_construct": ("source", "construct_s"),
+    # load_s / rss_growth_bytes ride in from the pre-binned open path;
+    # row_range / world_size from a rank-sharded open (schema 12)
+    "dataset_construct": ("source", "construct_s", "load_s",
+                          "rss_growth_bytes", "row_range", "world_size"),
+    "scaling": ("chips", "rows", "iters", "psum_bytes", "mode",
+                "baseline_rows_per_sec", "rows_per_sec"),
+    "mesh_shrink": ("reason", "checkpoint", "lost_ranks"),
+    "checkpoint": ("path", "bytes", "world_size"),
     "run_end": ("status", "health", "compile_attr", "stragglers",
                 # obs/merge.py merged-timeline summary
                 "rank_report"),

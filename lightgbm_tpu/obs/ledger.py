@@ -68,6 +68,12 @@ METRIC_DIRECTIONS = {
     "construct_s": -1,
     "vs_baseline": +1,
     "multichip_ok": +1,
+    # schema 12 scaling events (bench.py --mp): per-chip throughput and
+    # weak-scaling efficiency — gated per (suite, shape, device,
+    # world_size) cell so an N-rank run never regresses against a
+    # single-host baseline
+    "rows_per_sec_per_chip": +1,
+    "weak_scaling_eff": +1,
 }
 
 # noise floors under the MAD estimate: a flat history has MAD 0, and a
@@ -153,6 +159,10 @@ def metrics_from_events(events):
                         e.get("sketch_s", 0.0) + e.get("bin_s", 0.0)
                         + e.get("write_s", 0.0)))
             for e in cons)
+    sc = [e for e in events if e.get("ev") == "scaling"]
+    if sc:
+        out["rows_per_sec_per_chip"] = float(sc[-1]["rows_per_sec_per_chip"])
+        out["weak_scaling_eff"] = float(sc[-1]["efficiency"])
     return out
 
 
@@ -378,9 +388,13 @@ def rolling_stats(values, window):
 
 
 def comparable_entries(entries, suite=None, shape=None, device_kind=None,
-                       metric=None, status="ok", exclude_runs=()):
+                       metric=None, status="ok", exclude_runs=(),
+                       world_size=None):
     """The entries a candidate may be compared against: same suite /
-    shape / device kind (when given), clean outcome, metric present."""
+    shape / device kind / world size (when given), clean outcome, metric
+    present.  world_size is part of a run's shape identity: an N-rank
+    run's per-chip throughput must never gate against single-host
+    baselines (weak scaling is expected to be < 1.0)."""
     out = []
     for r in entries:
         if status and r.get("status") != status:
@@ -390,6 +404,9 @@ def comparable_entries(entries, suite=None, shape=None, device_kind=None,
         if shape and r.get("shape") != shape:
             continue
         if device_kind and r.get("device_kind") != device_kind:
+            continue
+        if world_size is not None and \
+                int(r.get("world_size", 1) or 1) != int(world_size):
             continue
         if metric and metric not in (r.get("metrics") or {}):
             continue
@@ -474,11 +491,16 @@ def _fmt_rev(rec):
 
 
 def _cells(entries):
-    """{(suite, shape, device_kind): [entries]} in first-seen order."""
+    """{(suite, shape, device_kind, world_size): [entries]} in
+    first-seen order.  world_size joined the cell key with schema 12: a
+    2-rank run and a 1-rank run of the same shape are different
+    performance regimes, and `obs trend --check` must never gate one
+    against the other's history."""
     out = {}
     for r in entries:
         key = (r.get("suite", ""), r.get("shape", ""),
-               r.get("device_kind", ""))
+               r.get("device_kind", ""),
+               int(r.get("world_size", 1) or 1))
         out.setdefault(key, []).append(r)
     return out
 
@@ -530,7 +552,7 @@ def render_trend(entries, out=None, suite=None, metric=None, window=8,
         entries = [r for r in entries if r.get("suite") == suite]
     active = []
     wrote = False
-    for (csuite, cshape, ckind), cell in _cells(entries).items():
+    for (csuite, cshape, ckind, cworld), cell in _cells(entries).items():
         metrics = sorted({k for r in cell
                           for k in (r.get("metrics") or {})},
                         key=lambda k: (k not in METRIC_DIRECTIONS, k))
@@ -544,9 +566,11 @@ def render_trend(entries, out=None, suite=None, metric=None, window=8,
             if not vals:
                 continue
             if not header_done:
-                w("%s%s / %s / %s  (%d run(s), %d clean)"
+                w("%s%s / %s / %s%s  (%d run(s), %d clean)"
                   % ("" if not wrote else "\n", csuite, cshape,
-                     ckind or "-", len(cell), len(clean)))
+                     ckind or "-",
+                     " / %d-rank" % cworld if cworld > 1 else "",
+                     len(cell), len(clean)))
                 w("  %-20s %4s %12s %12s %-16s  %s"
                   % ("metric", "n", "median", "last", "trend",
                      "change-points"))

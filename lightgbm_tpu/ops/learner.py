@@ -114,8 +114,11 @@ def paged_device_matrix(train_data, row_pad: int = 0):
     reader = getattr(train_data, "_binned_reader", None)
     if reader is None or reader.num_columns == 0 or reader.num_data == 0:
         return None
+    # iter_rows restricts paging to the reader's row_range — on a
+    # rank-sharded open (io/dataset.py from_binned(comm=...)) this rank
+    # uploads only its own rows and never maps a foreign shard
     parts = [jnp.asarray(np.ascontiguousarray(view))
-             for _, view in reader.iter_shards()]
+             for _, view in reader.iter_rows()]
     if row_pad:
         parts.append(jnp.zeros((int(row_pad), reader.num_columns),
                                parts[0].dtype))

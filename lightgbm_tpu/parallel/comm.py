@@ -199,6 +199,13 @@ def run_ranks(size: int, fn, fault=None, barrier_timeout=None):
     barrier = threading.Barrier(size)
 
     class _ThreadComm(HostComm):
+        # flipped once the rank pool exits: a TrainingData can outlive
+        # its run_ranks call (tests train on one rank's handle later),
+        # and a collective against departed peers would only time out —
+        # consumers (models/gbdt._dist_comm) treat a closed comm as
+        # single-process
+        closed = False
+
         def __init__(self, rank):
             self._rank = rank
             self._round = 0
@@ -244,9 +251,12 @@ def run_ranks(size: int, fn, fault=None, barrier_timeout=None):
                                 seq=i)
             return out
 
+    comms: List[Any] = [None] * size
+
     def runner(r):
         try:
-            results[r] = fn(_ThreadComm(r))
+            comms[r] = _ThreadComm(r)
+            results[r] = fn(comms[r])
         except threading.BrokenBarrierError as e:   # timeout/abort
             errors[r] = e
         except Exception as e:           # surface after join
@@ -263,6 +273,9 @@ def run_ranks(size: int, fn, fault=None, barrier_timeout=None):
         t.start()
     for t in threads:
         t.join()
+    for c in comms:
+        if c is not None:
+            c.closed = True
     real = [e for e in errors
             if e is not None
             and not isinstance(e, threading.BrokenBarrierError)]
@@ -325,6 +338,100 @@ class JaxProcessComm(HostComm):
         _observe_collective("allgather_obj", time.perf_counter() - t0,
                             nbytes=int(sizes.sum()), seq=seq)
         return out
+
+
+# -- process bootstrap ----------------------------------------------------
+# jax.distributed.initialize must run exactly once per process, before
+# any backend touch; the flag (not jax.process_count(), which would
+# itself initialize the backend) carries the idempotence.
+_DIST_INITIALIZED = False
+
+
+def distributed_init(config=None, coordinator=None, num_processes=None,
+                     process_id=None):
+    """Bootstrap this process into the pod and return its HostComm.
+
+    Resolution order per field: explicit argument > config param
+    (``dist_coordinator`` / ``dist_num_processes`` / ``dist_process_id``,
+    whose defaults ``""``/``0``/``-1`` mean "autodetect") > environment
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` — the variables parallel/launch.py exports to its
+    subprocess workers, matching real pod launchers).  When nothing
+    names a coordinator or a process count, the process is single-host:
+    no backend touch, ``SingleProcessComm`` back — engine.py can call
+    this unconditionally.
+
+    Idempotent: a second call (same process) skips the initialize and
+    just hands back a fresh ``JaxProcessComm`` on the live runtime.
+    """
+    global _DIST_INITIALIZED
+
+    def _pick(arg, cfg_key, env_key, cast, unset):
+        if arg is not None:
+            return cast(arg)
+        if config is not None:
+            v = getattr(config, cfg_key, unset)
+            if v is not None and cast(v) != unset:
+                return cast(v)
+        v = os.environ.get(env_key)
+        if v is not None and v != "" and cast(v) != unset:
+            return cast(v)
+        return None
+
+    coord = _pick(coordinator, "dist_coordinator",
+                  "JAX_COORDINATOR_ADDRESS", str, "")
+    nproc = _pick(num_processes, "dist_num_processes",
+                  "JAX_NUM_PROCESSES", int, 0)
+    pid = _pick(process_id, "dist_process_id", "JAX_PROCESS_ID", int, -1)
+
+    if coord is None and nproc is None:
+        if _DIST_INITIALIZED:
+            return JaxProcessComm()      # pod runtime already live
+        return SingleProcessComm()
+    if not _DIST_INITIALIZED:
+        import jax
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=nproc,
+                                       process_id=pid)
+        except RuntimeError as e:
+            # a co-resident caller (or the TPU runtime) beat us to it;
+            # anything else is a genuine bootstrap failure
+            if "already initialized" not in str(e):
+                raise
+        _DIST_INITIALIZED = True
+        if coord:
+            # JaxProcessComm reads the coordinator from the environment
+            # for the run-header rank context
+            os.environ.setdefault("JAX_COORDINATOR_ADDRESS", coord)
+    return JaxProcessComm()
+
+
+def reduce_metrics(comm: HostComm, values, weight=None):
+    """Row-weighted mean of per-rank eval-metric values, one collective
+    round (Network::Allreduce over metric sums, the reference's
+    provide-training-metric path).  ``values`` maps metric name to this
+    rank's local mean; ``weight`` is this rank's row count (1.0 when
+    omitted — unweighted mean).  Identity when single-rank."""
+    if comm is None or comm.size <= 1:
+        return dict(values)
+    mine = {"w": float(1.0 if weight is None else weight),
+            "v": {str(k): float(v) for k, v in values.items()}}
+    gathered = comm.allgather_obj(mine)
+    total_w = sum(g["w"] for g in gathered) or 1.0
+    return {k: sum(g["w"] * g["v"][k] for g in gathered) / total_w
+            for k in mine["v"]}
+
+
+def vote_stop(comm: HostComm, stop) -> bool:
+    """Unanimous early-stop vote: training halts only when EVERY rank
+    votes stop.  With bit-identical trees the votes always agree and the
+    collective is a barrier; under divergence (a bug, or asymmetric eval
+    sets) unanimity keeps every rank training the same number of
+    iterations instead of deadlocking a psum with departed peers."""
+    if comm is None or comm.size <= 1:
+        return bool(stop)
+    return all(bool(v) for v in comm.allgather_obj(bool(stop)))
 
 
 def sync_up_by_min(comm: HostComm, value):

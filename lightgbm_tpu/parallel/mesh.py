@@ -49,12 +49,14 @@ FEATURE_AXIS = "feature"
 
 def make_data_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (DATA_AXIS,))
+    # Device HANDLES (host metadata), not a device array — no transfer
+    return Mesh(np.asarray(devices), (DATA_AXIS,))  # lint: ignore[sync-asarray]
 
 
 def make_feature_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (FEATURE_AXIS,))
+    # Device HANDLES (host metadata), not a device array — no transfer
+    return Mesh(np.asarray(devices), (FEATURE_AXIS,))  # lint: ignore[sync-asarray]
 
 
 def _shard_map_compat(fn, mesh, in_specs, out_specs, checked=True):
@@ -351,11 +353,25 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 [arr, np.full((self._pad,), fill, self.dtype)])
         return make_row_sharded(self.mesh, arr)
 
+    def local_rows(self, global_arr):
+        """This process's rows of a row-sharded global array, pad
+        dropped — the bridge that lets the per-rank GBDT controller keep
+        LOCAL score/gradient arrays while the grow program psums over
+        the global mesh.  Pure addressable-shard reads: no cross-process
+        transfer, no host round-trip."""
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: int(s.index[0].start or 0))
+        parts = [s.data for s in shards]
+        loc = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return loc[:self.train_data.num_data]
+
     def train_device(self, grad, hess, row_mult=None, feature_mask=None):
-        """Grow one tree.  Multi-process callers drive this directly with
-        GLOBAL row-sharded arrays (tests/mp_worker.py is the model; the
-        Booster/GBDT layer is a single-controller API) and get the global
-        row->leaf map back; single-process callers pass host arrays."""
+        """Grow one tree.  Multi-process callers pass LOCAL row shards
+        (host or device); they come back as a GLOBAL row-sharded array
+        from _pad_rows_dev and the returned row->leaf map is global too
+        (tests/mp_worker.py drives this directly; the GBDT layer slices
+        it back to local rows via ``local_rows``).  Single-process
+        callers pass host arrays and get unpadded local maps."""
         grad = self._pad_rows_dev(grad)
         hess = self._pad_rows_dev(hess)
         if row_mult is None:

@@ -1,0 +1,161 @@
+"""Generic multi-process training worker (launch.py spec target).
+
+One function, ``train_worker(comm, payload)``, drives the FULL product
+path on one rank: rank-sharded dataset open (or deterministic synthetic
+data), the engine.train loop with its checkpoint/resume wiring, and the
+GBDT comm integration (metric reduce, stop votes, global-mesh grow).
+Subprocess mode runs it under ``run_ranks_subprocess`` (spec
+"lightgbm_tpu.parallel.worker:train_worker"); thread mode calls it
+directly from ``run_ranks`` ranks — same function, host-comm collectives
+only (threads share one backend, so each rank trains its shard on the
+local mesh; cross-process psum parity belongs to subprocess mode).
+
+Fault hooks (PR-4 ``LGBM_MP_*`` convention; payload keys override when
+the env is unset):
+
+* ``LGBM_MP_SLOW_RANK`` / ``LGBM_MP_SLOW_SECS`` — that rank sleeps
+  before every round (skew injection for the merged-timeline tests);
+* ``LGBM_MP_KILL_RANK`` / ``LGBM_MP_KILL_ITER`` — that rank dies after
+  completing ITER rounds of this run: ``os._exit(1)`` in subprocess mode
+  (payload ``kill_hard``, default), a raised RuntimeError in thread mode
+  — after the engine's checkpoint save for the round, so the elastic
+  drill resumes from it.
+
+Returns a JSON-able summary: model digest + tree count for bit-identity
+asserts, the reader's mapped-shard accounting for the no-foreign-mmap
+assert, and timing for the weak-scaling ledger.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Dict
+
+
+def make_data(rows: int, cols: int, seed: int):
+    """Deterministic synthetic binary-classification data.  Every rank
+    generates the FULL matrix from the seed and slices its shard — the
+    cheap stand-in for a shared filesystem."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols))
+    y = (X[:, 0] + np.sin(X[:, 1] * 2.0)
+         + 0.4 * rng.normal(size=rows) > 0).astype(np.float32)
+    return X, y
+
+
+def default_params() -> Dict[str, Any]:
+    return {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+            "min_data_in_leaf": 5, "verbose": -1, "learning_rate": 0.2,
+            "tree_learner": "data", "enable_bundle": False,
+            "bagging_seed": 3, "data_random_seed": 1,
+            "feature_fraction_seed": 2}
+
+
+def _env_or_payload_int(env_key: str, payload, key: str, default: int):
+    v = os.environ.get(env_key, "")
+    if v != "":
+        return int(v)
+    return int(payload.get(key, default))
+
+
+def train_worker(comm, payload):
+    p = dict(payload or {})
+    rows = int(p.get("rows", 2048))
+    cols = int(p.get("cols", 8))
+    rounds = int(p.get("num_rounds", 5))
+    seed = int(p.get("seed", 0))
+    size = max(int(getattr(comm, "size", 1) or 1), 1)
+    rank = int(getattr(comm, "rank", 0) or 0)
+
+    params = default_params()
+    params.update(p.get("params") or {})
+    if p.get("obs_path"):
+        # multi-rank observers auto-shard to <path>.r<rank>
+        params["obs_events_path"] = str(p["obs_path"])
+    if p.get("checkpoint_dir"):
+        params["checkpoint_dir"] = str(p["checkpoint_dir"])
+        params["checkpoint_every"] = int(p.get("checkpoint_every", 1))
+
+    from .. import engine as engine_mod
+    from ..basic import Dataset
+
+    mcomm = comm if size > 1 else None
+    binned_dir = str(p.get("binned_dir") or "")
+    if binned_dir:
+        # tentpole (b): rank-aware open of the pre-binned directory —
+        # this rank mmaps ONLY its row range of the shard table
+        ds = Dataset.from_binned(binned_dir, params=dict(params),
+                                 comm=mcomm)
+    else:
+        X, y = make_data(rows, cols, seed)
+        lo, hi = rank * rows // size, (rank + 1) * rows // size
+        ds = Dataset(X[lo:hi], label=y[lo:hi], params=dict(params))
+        if mcomm is not None:
+            # distributed bin finding: mappers agree across ranks via
+            # the host comm (io/dataset.py _construct_mappers_distributed)
+            from ..io.dataset import TrainingData
+            from ..utils.config import Config
+            ds._handle = TrainingData.from_matrix(
+                X[lo:hi], label=y[lo:hi], config=Config(dict(params)),
+                comm=mcomm)
+
+    slow_rank = _env_or_payload_int("LGBM_MP_SLOW_RANK", p,
+                                    "slow_rank", -1)
+    slow_secs = float(os.environ.get("LGBM_MP_SLOW_SECS",
+                                     p.get("slow_secs", 0.2)))
+    kill_rank = _env_or_payload_int("LGBM_MP_KILL_RANK", p,
+                                    "kill_rank", -1)
+    kill_iter = _env_or_payload_int("LGBM_MP_KILL_ITER", p,
+                                    "kill_iter", -1)
+    kill_hard = bool(p.get("kill_hard", True))
+
+    cbs = []
+    if rank == slow_rank and slow_secs > 0:
+        def _slow(env):
+            time.sleep(slow_secs)
+        _slow.before_iteration = True
+        cbs.append(_slow)
+    if rank == kill_rank and kill_iter >= 0:
+        state = {"n": 0}
+
+        def _kill(env):
+            # after-iteration: engine already wrote this round's
+            # checkpoint (when the cadence hit), so the survivors can
+            # resume from it
+            state["n"] += 1
+            if state["n"] >= kill_iter:
+                if kill_hard:
+                    os._exit(1)
+                raise RuntimeError(
+                    "injected rank kill (LGBM_MP_KILL_RANK=%d after %d "
+                    "round(s))" % (kill_rank, kill_iter))
+        cbs.append(_kill)
+
+    t0 = time.perf_counter()
+    booster = engine_mod.train(params, ds, num_boost_round=rounds,
+                               verbose_eval=False, callbacks=cbs)
+    train_s = time.perf_counter() - t0
+
+    gbdt = booster._gbdt
+    model_str = booster.model_to_string()
+    td = gbdt.train_data
+    reader = getattr(td, "_binned_reader", None)
+    out = {
+        "rank": rank,
+        "size": size,
+        "digest": hashlib.sha256(model_str.encode()).hexdigest()[:16],
+        "num_trees": len(gbdt.models),
+        "iter": int(gbdt.iter),
+        "num_data": int(td.num_data),
+        "train_s": train_s,
+    }
+    if reader is not None:
+        out["row_range"] = [int(reader.row_range[0]),
+                            int(reader.row_range[1])]
+        out["mapped_shards"] = sorted(int(i) for i in reader.mapped_shards)
+        out["active_shards"] = sorted(int(i) for i in reader.active_shards)
+    if p.get("return_model"):
+        out["model"] = model_str
+    return out

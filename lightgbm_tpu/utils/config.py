@@ -66,6 +66,14 @@ ALIAS_TABLE: Dict[str, str] = {
     "two_round_loading": "use_two_round_loading",
     "two_round": "use_two_round_loading",
     "mlist": "machine_list_file",
+    # multi-host pod bootstrap (parallel/comm.py distributed_init) and
+    # elastic checkpoint/resume (models/checkpoint.py)
+    "coordinator": "dist_coordinator",
+    "coordinator_address": "dist_coordinator",
+    "dist_world_size": "dist_num_processes",
+    "dist_rank": "dist_process_id",
+    "checkpoint_freq": "checkpoint_every",
+    "checkpoint_path": "checkpoint_dir",
     "is_save_binary": "is_save_binary_file",
     "save_binary": "is_save_binary_file",
     # out-of-core streaming ingest (io/streaming.py + io/binned_format.py)
@@ -181,6 +189,9 @@ PARAMETER_SET = {
     "valid_data_filenames", "snapshot_freq", "sparse_threshold",
     "enable_load_from_binary_file", "max_conflict_rate",
     "ooc_chunk_rows", "ooc_workers", "ooc_binned_dir",
+    # multi-host pod bootstrap + elastic checkpoint/resume
+    "dist_coordinator", "dist_num_processes", "dist_process_id",
+    "checkpoint_every", "checkpoint_dir",
     "poisson_max_delta_step", "gaussian_eta", "histogram_pool_size",
     "output_freq", "is_provide_training_metric", "machine_list_filename",
     "capacity",
@@ -403,6 +414,18 @@ class Config:
         "local_listen_port": ("int", 12400),
         "time_out": ("int", 120),
         "machine_list_file": ("str", ""),
+        # multi-host pod bootstrap (parallel/comm.py distributed_init):
+        # coordinator "host:port" ("" = env autodetect via
+        # JAX_COORDINATOR_ADDRESS), process count (0 = autodetect) and
+        # this process's id (-1 = autodetect)
+        "dist_coordinator": ("str", ""),
+        "dist_num_processes": ("int", 0),
+        "dist_process_id": ("int", -1),
+        # elastic fault tolerance (models/checkpoint.py): save a compact
+        # booster checkpoint every N iterations (0 = off) into
+        # checkpoint_dir so a shrunk mesh can resume mid-train
+        "checkpoint_every": ("int", 0),
+        "checkpoint_dir": ("str", ""),
         # tpu-native additions
         "tpu_use_dp": ("bool", False),
         # 'auto' | 'true' | 'false' — rank-encoded device bulk prediction

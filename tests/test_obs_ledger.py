@@ -206,6 +206,60 @@ def test_comparable_entries_filters(tmp_path):
     assert [r["run"] for r in comp] == ["r000", "r002"]
 
 
+def test_trend_cells_key_on_world_size(tmp_path, capsys):
+    """The backfill/gate guard (PR 14): a pod run is a DIFFERENT cell
+    than single-host history — `obs trend --check` must not gate a
+    2-rank run against 1-rank baselines, and comparable_entries must
+    filter by world size."""
+    led_dir = str(tmp_path / "led")
+    led = Ledger(led_dir)
+    _fill(led, 5, ips=5.0)               # 1-rank history
+    # a 2-rank run at HALF the rate: against the 1-rank cell this is a
+    # textbook >=3-MAD regression (test_obs_trend_check_exit_codes
+    # proves exactly that shape trips the gate) — world_size keying
+    # must keep it out of that cell entirely
+    evs = _events(run="pod", t=1e9 + 900, ips=2.5,
+                  git_rev="cafecafe1234")
+    evs[0]["world_size"] = 2
+    assert led.ingest_events(evs, suite="bench") == 1
+    assert led.entries()[-1]["world_size"] == 2
+    capsys.readouterr()
+    assert obs_main(["trend", led_dir, "--check"]) == 0, \
+        "2-rank run was gated against 1-rank history"
+
+    entries = led.entries()
+    comp2 = comparable_entries(entries, suite="bench",
+                               metric="iters_per_sec", world_size=2)
+    assert [r["run"] for r in comp2] == ["pod"]
+    comp1 = comparable_entries(entries, suite="bench",
+                               metric="iters_per_sec", world_size=1)
+    assert "pod" not in [r["run"] for r in comp1] and len(comp1) == 5
+
+
+def test_scaling_event_metrics_land_in_ledger(tmp_path):
+    """bench.py --mp emits one `scaling` event (schema 12); the ledger
+    must lift rows/sec/chip + weak-scaling efficiency out of it."""
+    evs = _events(run="mp", t=1e9)
+    evs[0]["world_size"] = 4
+    sc = {"ev": "scaling", "run": "mp", "t": 1e9 + 2.5, "world_size": 4,
+          "rows_per_sec_per_chip": 123.5, "efficiency": 0.91,
+          "chips": 4, "mode": "weak"}
+    assert validate_event(sc, strict=True) is sc   # schema-valid
+    evs.insert(3, sc)
+    m = metrics_from_events(evs)
+    assert m["rows_per_sec_per_chip"] == 123.5
+    assert m["weak_scaling_eff"] == 0.91
+    from lightgbm_tpu.obs.ledger import METRIC_DIRECTIONS
+    assert METRIC_DIRECTIONS["rows_per_sec_per_chip"] == 1
+    assert METRIC_DIRECTIONS["weak_scaling_eff"] == 1
+
+    led = Ledger(str(tmp_path / "led"))
+    assert led.ingest_events(evs, suite="bench_mp") == 1
+    rec = led.entries()[0]
+    assert rec["world_size"] == 4
+    assert rec["metrics"]["rows_per_sec_per_chip"] == 123.5
+
+
 def test_change_point_on_injected_step(tmp_path):
     led = Ledger(str(tmp_path / "led"))
     _fill(led, 5, ips=5.0)

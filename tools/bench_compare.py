@@ -185,6 +185,13 @@ def _from_timeline(events):
                         e.get("sketch_s", 0.0) + e.get("bin_s", 0.0)
                         + e.get("write_s", 0.0)))
             for e in cons)
+    # pod scale-out summary (schema v12, bench.py --mp) — kept in
+    # lockstep with obs/ledger.py metrics_from_events
+    sc = [e for e in events if e.get("ev") == "scaling"]
+    if sc:
+        out["rows_per_sec_per_chip"] = float(
+            sc[-1]["rows_per_sec_per_chip"])
+        out["weak_scaling_eff"] = float(sc[-1]["efficiency"])
     return out
 
 
@@ -329,7 +336,8 @@ def _candidate_cell(path, led):
                 or ctx.get("suite") or "")
     return {"run": run, "suite": suite,
             "shape": led._shape_bucket(events, header),
-            "device_kind": led._device_kind(header)}
+            "device_kind": led._device_kind(header),
+            "world_size": int(header.get("world_size", 1) or 1)}
 
 
 def rolling_rows(args, tols, base, cand):
@@ -345,6 +353,9 @@ def rolling_rows(args, tols, base, cand):
     suite = args.suite or cell.get("suite") or None
     shape = args.shape or cell.get("shape") or None
     device_kind = cell.get("device_kind") or None
+    # world_size is part of the candidate's shape identity (schema 12):
+    # a pod run only gates against same-world-size history
+    world_size = cell.get("world_size")
     rows, modes = [], {}
     for name, (direction, _) in METRICS.items():
         if name not in cand:
@@ -352,7 +363,7 @@ def rolling_rows(args, tols, base, cand):
         c = float(cand[name])
         comp = led.comparable_entries(
             entries, suite=suite, shape=shape, device_kind=device_kind,
-            metric=name, exclude_runs=exclude)
+            metric=name, exclude_runs=exclude, world_size=world_size)
         vals = [float(r["metrics"][name]) for r in comp]
         if len(vals) >= args.min_history:
             st = led.rolling_stats(vals, args.window)

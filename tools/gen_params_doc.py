@@ -252,6 +252,23 @@ NOTES = {
                       "mmap-able dataset directory during "
                       "construction; later runs can train straight "
                       "from the directory with zero re-binning",
+    "dist_coordinator": "multi-host pod bootstrap: coordinator "
+                        "host:port for jax.distributed.initialize "
+                        "(empty = JAX_COORDINATOR_ADDRESS env or "
+                        "single-process) — see Distributed.md",
+    "dist_num_processes": "world size of the pod (0 = "
+                          "JAX_NUM_PROCESSES env or single-process)",
+    "dist_process_id": "this process's rank in the pod (-1 = "
+                       "JAX_PROCESS_ID env)",
+    "checkpoint_every": "save a compact booster checkpoint (trees + "
+                        "iteration + RNG seeds + config fingerprint) "
+                        "every k rounds (0 = off); rank 0 writes "
+                        "atomically into checkpoint_dir",
+    "checkpoint_dir": "checkpoint directory; a resumable checkpoint "
+                      "found here at train() start resumes the run "
+                      "(elastic shrink-and-resume after a lost rank "
+                      "re-opens re-balanced shards and continues) — "
+                      "see Distributed.md",
 }
 
 GROUPS = [
@@ -287,7 +304,9 @@ GROUPS = [
         "convert_model_language"]),
     ("Distributed", [
         "num_machines", "top_k", "local_listen_port", "time_out",
-        "machine_list_file", "histogram_pool_size"]),
+        "machine_list_file", "histogram_pool_size",
+        "dist_coordinator", "dist_num_processes", "dist_process_id",
+        "checkpoint_every", "checkpoint_dir"]),
     ("TPU-native", [
         "tpu_growth", "tpu_wave_width", "tpu_wave_order", "tpu_wave_chunk",
         "tpu_wave_lookup", "tpu_wave_compact", "tpu_histogram_mode",
