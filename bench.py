@@ -333,7 +333,12 @@ def child():
         os.unlink(obs_path)
     except OSError:
         pass
-    params.update({"obs_events_path": obs_path, "obs_timing": "iter"})
+    # obs_compile + obs_utilization_every: the timeline carries per-entry
+    # cost estimates and a per-iteration `utilization` roofline rollup
+    # (schema 13), so flop_util/hbm_util land in the ledger as gated
+    # cells next to it/s
+    params.update({"obs_events_path": obs_path, "obs_timing": "iter",
+                   "obs_compile": True, "obs_utilization_every": 1})
     # land the finished run in the cross-run ledger (obs/ledger.py) so
     # `obs trend` / bench_compare --baseline rolling see the history;
     # LGBM_TPU_LEDGER="" disables, any failure only logs a warning
@@ -392,6 +397,7 @@ def child():
     # the telemetry is somehow unusable — the measurement must not die on
     # an instrumentation bug
     gbdt._obs.close()
+    flop_util = hbm_util = None
     try:
         from lightgbm_tpu.obs import read_events
         evs = read_events(obs_path)
@@ -401,6 +407,13 @@ def child():
         dt_obs = sum(e["time_s"] for e in iter_recs[-MEASURED:])
         assert dt_obs > 0
         ips = MEASURED / dt_obs
+        # last utilization rollup = steady-state roofline position (the
+        # same record ledger.metrics_from_events reads) — absent only if
+        # the instrumentation failed, which must not kill the bench
+        utils = [e for e in run if e["ev"] == "utilization"]
+        if utils and utils[-1].get("flop_util") is not None:
+            flop_util = float(utils[-1]["flop_util"])
+            hbm_util = float(utils[-1].get("hbm_util", 0.0))
     except Exception as e:
         print("bench: timeline unusable (%s); falling back to wall clock"
               % e, file=sys.stderr, flush=True)
@@ -432,6 +445,14 @@ def child():
         # --tol-construct
         "construct_s": (round(construct_s, 3) if construct_s is not None
                         else None),
+        # roofline attribution (obs/roofline.py): achieved-vs-peak for
+        # the measured window — bench_compare gates both with
+        # --tol-flop-util / --tol-hbm-util so a kernel change that
+        # silently drops hardware utilization fails the gate
+        "flop_util": (round(flop_util, 4) if flop_util is not None
+                      else None),
+        "hbm_util": (round(hbm_util, 4) if hbm_util is not None
+                     else None),
     }))
 
 
@@ -475,7 +496,8 @@ def dry():
               "obs_compile": True, "obs_split_audit": True,
               "obs_importance_every": 2,
               "obs_ledger_dir": ledger_dir,
-              "obs_ledger_suite": "bench_dry"}
+              "obs_ledger_suite": "bench_dry",
+              "obs_utilization_every": 1}
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
 
     # bucketed device predict: varying batch sizes must land on the
@@ -500,8 +522,23 @@ def dry():
     for need in ("run_header", "iter", "compile", "compile_attr",
                  "memory", "health", "metrics", "run_end",
                  "data_profile", "split_audit", "importance",
-                 "dataset_construct"):
+                 "dataset_construct", "utilization"):
         assert need in kinds, "timeline missing %r events" % need
+    # roofline rollup (schema 13): every utilization record must carry
+    # the achieved-vs-peak ratios and classify every jitted entry —
+    # this timeline is the one CI feeds `obs roofline --check`
+    util_recs = [e for e in evs if e["ev"] == "utilization"]
+    for u in util_recs:
+        assert 0.0 <= u.get("flop_util", -1.0) <= 1.0, \
+            "utilization record missing flop_util: %r" % u
+        assert 0.0 <= u.get("hbm_util", -1.0) <= 1.0, \
+            "utilization record missing hbm_util: %r" % u
+        assert u.get("bound") and u.get("entries"), \
+            "utilization record missing bound/entries: %r" % u
+        assert all(v.get("bound") for v in u["entries"].values()), \
+            "utilization entry without a bound classification: %r" % u
+    assert util_recs[-1].get("device_kind"), \
+        "utilization rollup missing device_kind"
     audits = [e for e in evs if e["ev"] == "split_audit"]
     assert all(e["splits"] for e in audits), "empty split_audit event"
     assert all(s["gain"] > 0 for e in audits for s in e["splits"]), \
@@ -665,6 +702,7 @@ def dry():
                       "compile_attr": len(attr),
                       "autotune_decisions": len(decs),
                       "dataset_construct": len(cons),
+                      "utilization": len(util_recs),
                       "fused_iters": len(fused_iters),
                       "mid_tree_syncs": 0,
                       "path": obs_path}))

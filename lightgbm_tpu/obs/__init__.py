@@ -36,8 +36,16 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   high-cardinality flags, label balance — emitted as a ``data_profile``
   event and routed through the health channel so a degenerate dataset
   fails fast under ``obs_health=fatal``;
+* ``roofline`` — roofline attribution: a device-peak registry (per
+  ``device_kind`` FLOP/s, HBM and ICI bandwidth, VMEM — with a CPU
+  fallback so the layer is testable off-TPU) joined against the
+  ``compile_attr`` cost estimates and measured execute times to give
+  every jitted entry achieved-vs-peak utilization, arithmetic
+  intensity, a compute/memory/collective/host-orchestration bound and
+  headroom seconds; emits the per-iteration ``utilization`` rollup
+  (``obs_utilization_every``, schema 13) and stamps autotune probes;
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
-  obs summary|recompiles|stragglers|explain|merge|diff|trace``;
+  obs summary|recompiles|stragglers|explain|roofline|merge|diff|trace``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -65,7 +73,8 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_compile``, ``obs_straggler_every``, ``obs_straggler_warn_skew``,
 ``obs_watchdog_secs``, ``obs_flight_events``, ``obs_split_audit``,
 ``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``,
-``obs_ledger_dir``, ``obs_ledger_suite``, ``obs_ledger_window``.
+``obs_ledger_dir``, ``obs_ledger_suite``, ``obs_ledger_window``,
+``obs_utilization_every``, ``obs_roofline_peaks``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -111,8 +120,8 @@ def observer_from_config(config, comm=None):
     Any of ``obs_events_path`` / ``obs_trace_iters`` / ``obs_memory_every``
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
     ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every`` /
-    ``obs_split_audit`` / ``obs_importance_every`` / ``obs_ledger_dir``
-    enables the observer; health, metrics, compile and model tracking
+    ``obs_split_audit`` / ``obs_importance_every`` / ``obs_ledger_dir`` /
+    ``obs_utilization_every`` enables the observer; health, metrics, compile and model tracking
     work without an events path (in-memory timeline via
     Booster.telemetry()).  A non-empty ``obs_ledger_dir`` additionally
     ingests the finished run into the cross-run ledger on clean close.
@@ -132,11 +141,14 @@ def observer_from_config(config, comm=None):
     split_audit = bool(getattr(config, "obs_split_audit", False))
     importance_every = int(getattr(config, "obs_importance_every", 0) or 0)
     ledger_dir = str(getattr(config, "obs_ledger_dir", "") or "")
+    utilization_every = int(getattr(config, "obs_utilization_every", 0)
+                            or 0)
     if (not events_path and not trace_iters and memory_every <= 0
             and health_mode == "off" and not metrics_path
             and metrics_every <= 0 and not compile_attr
             and straggler_every <= 0 and not split_audit
-            and importance_every <= 0 and not ledger_dir):
+            and importance_every <= 0 and not ledger_dir
+            and utilization_every <= 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -187,4 +199,8 @@ def observer_from_config(config, comm=None):
                        ledger_dir=ledger_dir,
                        ledger_suite=str(
                            getattr(config, "obs_ledger_suite", "")
+                           or ""),
+                       utilization_every=utilization_every,
+                       roofline_peaks=str(
+                           getattr(config, "obs_roofline_peaks", "")
                            or ""))

@@ -168,20 +168,20 @@ def _cache_size(fn):
         return None
 
 
-def analyze_compiled(fn, args):
-    """FLOPs + memory estimates via the AOT path (fn.lower().compile()).
+def parse_compiled(compiled):
+    """Cost/memory estimates of an already-``Compiled`` program, as the
+    ``{cost: {...}, memory: {...}}`` sub-dicts of a ``compile_attr``
+    event.  THE shared parser — the JIT path here (``analyze_compiled``)
+    and the serve tier's AOT executables (serve/executable.py) both
+    read XLA's analyses through it, so the list-vs-dict
+    ``cost_analysis`` backend quirk is handled exactly once.
 
     ``cost_analysis`` returns a list of per-program dicts on recent jax
-    CPU backends and a bare dict elsewhere; ``memory_analysis`` returns a
-    ``CompiledMemoryStats``.  Both are optional per backend, so every
+    CPU backends and a bare dict elsewhere; ``memory_analysis`` returns
+    a ``CompiledMemoryStats``.  Both are optional per backend, so every
     step is guarded — analysis failure only shrinks the event.
     """
     out = {}
-    try:
-        compiled = fn.lower(*args).compile()
-    except Exception as e:                      # non-jit entry, AOT refusal
-        Log.debug("obs: compile analysis unavailable for %r: %s", fn, e)
-        return out
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -209,6 +209,25 @@ def analyze_compiled(fn, args):
     except Exception:
         pass
     return out
+
+
+def analyze_compiled(fn, args):
+    """FLOPs + memory estimates via the AOT path (fn.lower().compile())
+    of a jitted entry; the parse itself is ``parse_compiled``.
+
+    Entries registered through a plain-Python wrapper (e.g. the learner's
+    ``tree_grow`` closure binding meta/bundle onto the memoized jit core)
+    have no ``.lower`` of their own; they expose the core's lowering as an
+    ``_aot_lower(*observed_args)`` attribute instead.
+    """
+    try:
+        aot = getattr(fn, "_aot_lower", None)
+        lowered = aot(*args) if aot is not None else fn.lower(*args)
+        compiled = lowered.compile()
+    except Exception as e:                      # non-jit entry, AOT refusal
+        Log.debug("obs: compile analysis unavailable for %r: %s", fn, e)
+        return {}
+    return parse_compiled(compiled)
 
 
 class CompileTracker:
@@ -279,6 +298,11 @@ class CompileTracker:
             fields["cache_size"] = cache1
         if self._analyze:
             fields.update(analyze_compiled(fn, args))
+            if "cost" in fields:
+                # steady-state cost of the entry's LAST compile — the
+                # roofline rollup (obs/roofline.py) joins these against
+                # the entry timers every obs_utilization_every iters
+                st["last_cost"] = fields["cost"]
         st["last_compiled_sig"] = sig
         obs.event("compile_attr", **fields)
         labels = {"entry": name}
@@ -316,6 +340,12 @@ class CompileTracker:
                         name, st["compiles"],
                         "; ".join(format_diff(d) for d in diff)
                         or "signature unchanged")
+
+    def costs(self):
+        """{entry: cost dict} of the last compile per entry — the
+        roofline join's FLOPs/bytes side (obs/roofline.py)."""
+        return {name: st["last_cost"] for name, st in self._entries.items()
+                if st.get("last_cost")}
 
     def summary(self):
         """Folded into run_end: per-entry compile/call/signature counts."""

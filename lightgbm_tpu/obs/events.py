@@ -62,6 +62,10 @@ dataset_construct rows, chunks, sketch_s, bin_s, write_s,
                io/streaming.py — one dataset construction: source kind,
                two-pass phase seconds, worker-pool width, RSS watermark;
                `construct_s` is gated by tools/bench_compare.py)
+utilization    it, entries (schema 13; obs/roofline.py — per-iteration
+               roofline rollup: exec-weighted flop_util / hbm_util
+               against the device-peak registry, dominant bound, total
+               headroom seconds; the ledger cells bench_compare gates)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -100,20 +104,22 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
 # 7 (no autotune/band-escape events), 8 (no dataset_construct),
 # 9 (no run_header provenance), 10 (no host_orchestration_s iter
 # field — schema 11 adds the host-glue seconds between device program
-# submissions, models/gbdt.py OrchestrationClock) and 11 (no pod
+# submissions, models/gbdt.py OrchestrationClock), 11 (no pod
 # scale-out events — schema 12 adds scaling / mesh_shrink / checkpoint
-# and the sharded-ingest dataset_construct fields) timelines still
-# parse.  wave_band_escape stays accepted for old timelines even though
-# nothing emits it anymore (the band prior died in PR-11;
-# ops/pallas_wave.py tile planner post-mortem).
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+# and the sharded-ingest dataset_construct fields) and 12 (no roofline
+# attribution — schema 13 adds the per-iteration ``utilization``
+# rollup and the ``autotune_probe.roofline`` cell stamp, obs/
+# roofline.py) timelines still parse.  wave_band_escape stays accepted
+# for old timelines even though nothing emits it anymore (the band
+# prior died in PR-11; ops/pallas_wave.py tile planner post-mortem).
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -175,6 +181,11 @@ _REQUIRED = {
     "scaling": ("world_size", "rows_per_sec_per_chip", "efficiency"),
     "mesh_shrink": ("world_size_from", "world_size_to", "it"),
     "checkpoint": ("it",),
+    # schema 13 (obs/roofline.py): per-iteration roofline rollup —
+    # exec-weighted achieved/peak utilization across the timed entries,
+    # joined from CompileTracker cost estimates and the device-peak
+    # registry (obs_utilization_every)
+    "utilization": ("it", "entries"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -230,7 +241,10 @@ _OPTIONAL = {
                   "burn_long", "targets", "verdicts"),
     "serve_summary": ("pad_rows", "max_queue_depth", "requests", "shed",
                       "executables", "slo"),
-    "autotune_probe": ("bucket", "waves"),
+    # schema 13: every probed cell carries its analytic roofline stamp
+    # (flop/hbm utilization at the measured s/wave, dominant bound) so
+    # `obs explain` can say why the winner won — obs/roofline.py
+    "autotune_probe": ("bucket", "waves", "roofline"),
     "autotune_decision": ("bucket", "device_kind", "prior", "cells",
                           "margin", "overhead_s", "cache_hit",
                           "cache_path"),
@@ -246,6 +260,8 @@ _OPTIONAL = {
                 "baseline_rows_per_sec", "rows_per_sec"),
     "mesh_shrink": ("reason", "checkpoint", "lost_ranks"),
     "checkpoint": ("path", "bytes", "world_size"),
+    "utilization": ("flop_util", "hbm_util", "bound", "headroom_s",
+                    "device_kind", "roof_source"),
     "run_end": ("status", "health", "compile_attr", "stragglers",
                 # obs/merge.py merged-timeline summary
                 "rank_report"),
@@ -624,7 +640,8 @@ class RunObserver(NullObserver):
                  compile_attr=False, straggler_every=0,
                  straggler_warn_skew=0.5, rank=None, world_size=None,
                  coordinator="", fsync=False, watchdog_secs=0.0,
-                 flight_events=256, ledger_dir="", ledger_suite=""):
+                 flight_events=256, ledger_dir="", ledger_suite="",
+                 utilization_every=0, roofline_peaks=""):
         from . import metrics as metrics_mod
         if rank is None or world_size is None:
             info = _default_rank_info()
@@ -659,6 +676,14 @@ class RunObserver(NullObserver):
         self._registry = metrics_mod.REGISTRY
         self._compile = None
         if compile_attr:
+            from .compile import CompileTracker
+            self._compile = CompileTracker(self._registry)
+        # roofline rollup cadence (obs_utilization_every): needs the
+        # compile tracker's cost estimates, so it implies obs_compile
+        self._utilization_every = max(0, int(utilization_every or 0))
+        self._roofline_peaks_path = str(roofline_peaks or "")
+        self._roofline_peaks = None          # resolved lazily, once
+        if self._utilization_every and self._compile is None:
             from .compile import CompileTracker
             self._compile = CompileTracker(self._registry)
         self._straggler = None
@@ -743,7 +768,35 @@ class RunObserver(NullObserver):
             self.health.check_memory(self, it, devices)
         if self._metrics_every and it % self._metrics_every == 0:
             self.event("metrics", it=it, scrape=self._registry.snapshot())
+        if self._utilization_every and it % self._utilization_every == 0:
+            self._emit_utilization(it)
         self._trace.maybe_stop(it, self)
+
+    def _emit_utilization(self, it):
+        """The schema-13 roofline rollup (obs/roofline.py): exec-weighted
+        achieved/peak utilization of every timed entry with a cost
+        estimate.  No fence, no device work — it joins numbers the
+        observer already holds, so the cadence costs host time only."""
+        from . import roofline
+        if self._roofline_peaks is None:
+            overrides = roofline.load_peak_overrides(
+                self._roofline_peaks_path)
+            self._roofline_peaks = roofline.peaks_for(
+                roofline.device_kind(), overrides)
+        rollup = roofline.utilization_rollup(
+            self._entries.summary(),
+            self._compile.costs() if self._compile is not None else {},
+            self._roofline_peaks, world_size=self.world_size)
+        if rollup is not None:
+            self.event("utilization", it=it, **rollup)
+            self._registry.gauge(
+                "lgbm_flop_utilization",
+                "exec-weighted achieved/peak FLOP fraction at the last "
+                "utilization rollup").set(rollup["flop_util"])
+            self._registry.gauge(
+                "lgbm_hbm_utilization",
+                "exec-weighted achieved/peak HBM-bandwidth fraction at "
+                "the last utilization rollup").set(rollup["hbm_util"])
 
     # -- jitted entry points ------------------------------------------
     def entry_start(self):

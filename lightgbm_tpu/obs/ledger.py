@@ -74,6 +74,11 @@ METRIC_DIRECTIONS = {
     # single-host baseline
     "rows_per_sec_per_chip": +1,
     "weak_scaling_eff": +1,
+    # schema 13 utilization rollups (obs/roofline.py): exec-weighted
+    # achieved/peak fractions — a drop means a kernel moved AWAY from
+    # its roof, the regression class the roofline layer exists to catch
+    "flop_util": +1,
+    "hbm_util": +1,
 }
 
 # noise floors under the MAD estimate: a flat history has MAD 0, and a
@@ -163,6 +168,12 @@ def metrics_from_events(events):
     if sc:
         out["rows_per_sec_per_chip"] = float(sc[-1]["rows_per_sec_per_chip"])
         out["weak_scaling_eff"] = float(sc[-1]["efficiency"])
+    # schema 13: the LAST utilization rollup is the steady-state one
+    # (early iterations still amortize compile-time in their means)
+    utils = [e for e in events if e.get("ev") == "utilization"]
+    if utils and utils[-1].get("flop_util") is not None:
+        out["flop_util"] = float(utils[-1]["flop_util"])
+        out["hbm_util"] = float(utils[-1].get("hbm_util", 0.0))
     return out
 
 

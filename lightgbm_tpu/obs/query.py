@@ -17,6 +17,13 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   ``data_profile`` / ``importance`` / ``split_audit`` / ``eval`` events:
   suspicious-data findings, top-feature evolution, gain-margin summary
   and convergence; ``--check`` exits 1 on error-severity data findings;
+* ``roofline RUN.jsonl``      — roofline attribution (obs/roofline.py):
+  achieved vs peak FLOP/s and HBM bandwidth per jitted entry from the
+  ``compile_attr`` cost estimates, the run_end execute stats and the
+  device-peak registry, ranked by recoverable headroom seconds with a
+  compute/memory/collective/host-orchestration bound per entry;
+  ``--check`` exits 1 when the timeline cannot be attributed at all
+  (no finished run, or no cost estimates) — the CI gate;
 * ``serve RUN.jsonl``         — serving-tier report (obs/serve.py):
   per-route latency table from sampled ``serve_request`` traces, SLO
   verdicts and burn rates from ``serve_slo`` snapshots, shed/overload
@@ -273,6 +280,7 @@ def render_recompiles(events, out=None):
     """Every compile_attr event; True iff any same-signature recompile
     (jit-cache thrash) is present — the --check failure condition."""
     from .compile import format_diff
+    from .roofline import fmt_bytes, fmt_quantity
     out = out or sys.stdout
     w = lambda s="": out.write(s + "\n")
     rows = recompile_rows(events)
@@ -284,9 +292,14 @@ def render_recompiles(events, out=None):
     for r in rows:
         why = "; ".join(format_diff(d) for d in r["diff"]) \
             or "first compile"
-        flops = (r["cost"] or {}).get("flops")
-        if flops is not None:
-            why += "  [%.3g flops]" % flops
+        cost = r["cost"] or {}
+        tags = []
+        if cost.get("flops") is not None:
+            tags.append(fmt_quantity(cost["flops"], "FLOP"))
+        if cost.get("bytes_accessed") is not None:
+            tags.append(fmt_bytes(cost["bytes_accessed"]))
+        if tags:
+            why += "  [%s]" % ", ".join(tags)
         w("%-14s %4d %5d  %s" % (r["entry"], r["n_compiles"],
                                  r["sig_compiles"], why))
         if r["sig_compiles"] > 1:
@@ -474,6 +487,7 @@ def render_explain(events, out=None, topk=10):
             w(line)
             cells = e.get("cells") or ()
             if cells:
+                from .roofline import describe_roofline_position
                 best = min((c.get("s_per_wave") for c in cells
                             if c.get("s_per_wave") is not None),
                            default=None)
@@ -481,6 +495,11 @@ def render_explain(events, out=None, topk=10):
                     s = c.get("s_per_wave")
                     tag = " <- winner" if (s is not None and s == best) \
                         else ""
+                    # schema 13: the probe's roofline stamp says WHY —
+                    # e.g. "pallas_ct at 71% HBM vs pallas_t at 34%"
+                    pos = describe_roofline_position(c.get("roofline"))
+                    if pos:
+                        tag = "  [at %s]%s" % (pos, tag)
                     w("    %-34s %10.6f s/wave%s"
                       % (_cell(c.get("cell") or {}),
                          s if s is not None else float("nan"), tag))
@@ -631,6 +650,18 @@ def main(argv=None):
                    help="exit 1 on shed requests, fired burn-rate "
                         "alerts or failing SLO verdicts — the CI gate "
                         "for non-overload load")
+    p = sub.add_parser("roofline",
+                       help="achieved-vs-peak utilization per jitted "
+                            "entry, ranked by recoverable headroom "
+                            "seconds (obs/roofline.py)")
+    p.add_argument("timeline")
+    p.add_argument("--peaks", default="",
+                   help="JSON device-peak overrides "
+                        "(obs_roofline_peaks format)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when the timeline cannot be attributed "
+                        "(no finished run, or no cost estimates — run "
+                        "with obs_compile=true) — the CI gate")
     p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
                                      "of per-rank shards")
     p.add_argument("shards", nargs="+",
@@ -728,6 +759,12 @@ def main(argv=None):
     elif args.cmd == "serve":
         from .serve import render_serve_report
         problems = render_serve_report(events, check=args.check)
+        if args.check and problems:
+            return 1
+    elif args.cmd == "roofline":
+        from .roofline import render_roofline
+        problems = render_roofline(events, check=args.check,
+                                   peaks_path=args.peaks)
         if args.check and problems:
             return 1
     elif args.cmd == "diff":

@@ -490,6 +490,57 @@ def serve_headline(events):
     return head
 
 
+def serve_roofline(events):
+    """Roofline rows for the per-bucket AOT predict executables.
+
+    serve/executable.py emits each bucket's program as a
+    ``compile_attr`` event named ``serve_predict_b<bucket>[_conv]``
+    carrying the shared cost/memory parse (obs/compile.py
+    parse_compiled); the sampled ``serve_batch`` events time the same
+    buckets' executes.  Joining the two against the device-peak
+    registry (obs/roofline.py) gives the serving tier the same
+    achieved-vs-peak treatment the training entries get."""
+    from .roofline import entry_roofline, peaks_for
+    costs = {}
+    for e in events:
+        if e.get("ev") == "compile_attr" and e.get("cost") \
+                and str(e.get("entry", "")).startswith("serve_predict_b"):
+            costs[e["entry"]] = e["cost"]
+    if not costs:
+        return []
+    header = next((e for e in events if e.get("ev") == "run_header"), {})
+    kind = ""
+    for d in header.get("devices") or ():
+        if isinstance(d, dict) and d.get("kind"):
+            kind = str(d["kind"])
+            break
+    peaks = peaks_for(kind or str(header.get("backend", "") or ""))
+    # executes per bucket from the sampled microbatch events
+    execs = {}
+    for e in events:
+        if e.get("ev") != "serve_batch":
+            continue
+        b = e.get("bucket")
+        execs.setdefault(b, []).append(float(e.get("exec_s", 0.0)))
+    rows = []
+    for entry, cost in sorted(costs.items()):
+        suffix = entry[len("serve_predict_b"):]
+        try:
+            bucket = int(suffix.split("_")[0])
+        except ValueError:
+            bucket = None
+        xs = execs.get(bucket) or []
+        mean = (sum(xs) / len(xs)) if xs else 0.0
+        r = entry_roofline(cost, mean, len(xs), peaks)
+        r["entry"] = entry
+        r["bucket"] = bucket
+        r["timed"] = bool(xs)
+        r["roof_source"] = peaks.get("source")
+        rows.append(r)
+    rows.sort(key=lambda r: -r["headroom_s"])
+    return rows
+
+
 def _ms(v):
     return "-" if v is None else "%.2f" % (float(v) * 1e3)
 
@@ -598,6 +649,21 @@ def render_serve_report(events, out=None, check=False):
              _ms(bench.get("p99_s")),
              ("  shed_rate %s" % bench.get("shed_rate")
               if bench.get("shed_rate") is not None else "")))
+
+    rl = serve_roofline(events)
+    if rl:
+        w("")
+        w("executable roofline (achieved vs %s peaks, obs/roofline.py):"
+          % (rl[0].get("roof_source", "?")))
+        w("  %-26s %7s %10s %6s %6s %-18s %9s" %
+          ("entry", "execs", "exec_p50", "MXU%", "HBM%", "bound",
+           "headroom"))
+        for r in rl:
+            w("  %-26s %7d %8.3fms %5.1f%% %5.1f%% %-18s %8.4fs%s"
+              % (r["entry"][:26], r["exec_n"], r["exec_mean_s"] * 1e3,
+                 100 * r["flop_util"], 100 * r["hbm_util"], r["bound"],
+                 r["headroom_s"],
+                 "" if r["timed"] else "  (no sampled executes)"))
     w("")
     if problems:
         w("verdict: %s — %s" % ("FAIL" if check else "UNHEALTHY",

@@ -538,9 +538,23 @@ def measure_cells(cells: List[Cell], bucket: ShapeBucket, probe,
             continue
         events.append(("autotune_probe", {
             "bucket": bucket.key(), "cell": cell.as_dict(),
-            "waves": waves, "s_per_wave": s_per_wave}))
+            "waves": waves, "s_per_wave": s_per_wave,
+            "roofline": _cell_roofline(bucket, cell, s_per_wave)}))
         out.append((cell, s_per_wave))
     return out
+
+
+def _cell_roofline(bucket: ShapeBucket, cell: Cell, s_per_wave: float):
+    """Schema-13 roofline stamp for one probed cell (obs/roofline.py):
+    where its measured s/wave sits against this chip's compute and
+    memory roofs, so `obs explain` can say why the winner won.
+    Best-effort — attribution must never fail a probe."""
+    try:
+        from ..obs.roofline import cell_roofline
+        return cell_roofline(bucket, cell, s_per_wave,
+                             kind=_device_kind())
+    except Exception:  # noqa: BLE001 — stamp is telemetry, not control
+        return None
 
 
 def decide(config: Config, bucket: ShapeBucket, prior: Cell, pins: Pins,
@@ -562,7 +576,8 @@ def decide(config: Config, bucket: ShapeBucket, prior: Cell, pins: Pins,
             "mode": mode, "source": source, "bucket": bucket.key(),
             "device_kind": _device_kind(), "cell": cell.as_dict(),
             "prior": prior.as_dict(),
-            "cells": [{"cell": c.as_dict(), "s_per_wave": s}
+            "cells": [{"cell": c.as_dict(), "s_per_wave": s,
+                       "roofline": _cell_roofline(bucket, c, s)}
                       for c, s in probes],
             "margin": float(margin), "overhead_s": float(overhead),
             "cache_hit": bool(cache_hit), "cache_path": cache_path}))

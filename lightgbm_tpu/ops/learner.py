@@ -601,6 +601,13 @@ class SerialTreeLearner:
                       _bund=bund, _xt=xt):
                 return _core(X, g, h, rm, m, _meta, _bund, Xt=_xt)
 
+            # AOT hook for obs compile attribution: the wrapper itself is
+            # not jitted, so expose the core's lowering over the observed
+            # call args (obs/compile.py analyze_compiled)
+            _grow._aot_lower = (
+                lambda X, g, h, rm, m, _core=core, _meta=meta,
+                _bund=bund, _xt=xt:
+                _core.lower(X, g, h, rm, m, _meta, _bund, Xt=_xt))
             self._grow = _grow
         elif psum_axis is None:
             # cached jitted core: a second booster/fold with the same
@@ -619,6 +626,9 @@ class SerialTreeLearner:
             def _grow(X, g, h, rm, m, _core=core, _meta=meta, _bund=bund):
                 return _core(X, g, h, rm, m, _meta, _bund)
 
+            _grow._aot_lower = (
+                lambda X, g, h, rm, m, _core=core, _meta=meta, _bund=bund:
+                _core.lower(X, g, h, rm, m, _meta, _bund))
             self._grow = _grow
         elif sparse_on:
             # the data-parallel mesh subclass owns the sparse grow (it

@@ -44,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..obs.compile import arg_signature, render_signature
+from ..obs.compile import arg_signature, parse_compiled, render_signature
 from ..obs.events import NULL_OBSERVER
 from ..obs.metrics import REGISTRY
 from ..obs.timers import fenced_get
@@ -73,38 +73,6 @@ def _fused_conversion(objective):
     if type(objective).convert_output is ObjectiveFunction.convert_output:
         return None                      # identity: converted == raw
     return "host"
-
-
-def _compiled_analysis(compiled):
-    """cost/memory estimates off an already-compiled program (the same
-    fields obs/compile.py attaches); best-effort per backend."""
-    out = {}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        cost = {}
-        if ca and "flops" in ca:
-            cost["flops"] = float(ca["flops"])
-        if ca and "bytes accessed" in ca:
-            cost["bytes_accessed"] = float(ca["bytes accessed"])
-        if cost:
-            out["cost"] = cost
-    except Exception:
-        pass
-    try:
-        ma = compiled.memory_analysis()
-        mem = {}
-        for field in ("argument_size_in_bytes", "output_size_in_bytes",
-                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
-            v = getattr(ma, field, None)
-            if v is not None:
-                mem[field.replace("_size_in_bytes", "_bytes")] = int(v)
-        if mem:
-            out["memory"] = mem
-    except Exception:
-        pass
-    return out
 
 
 class PredictExecutableCache:
@@ -269,7 +237,7 @@ class PredictExecutableCache:
             fields = {"entry": entry, "n_compiles": 1,
                       "sig": render_signature(sig), "sig_compiles": 1,
                       "diff": []}
-            fields.update(_compiled_analysis(compiled))
+            fields.update(parse_compiled(compiled))
             obs.event("compile", entry=entry, first_call_s=dt, fenced=True)
             obs.event("compile_attr", **fields)
         Log.debug("serve: compiled %s in %.3fs (donate=%s, devices=%d)",

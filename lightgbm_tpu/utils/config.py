@@ -137,6 +137,9 @@ ALIAS_TABLE: Dict[str, str] = {
     "ledger_suite": "obs_ledger_suite",
     "ledger_window": "obs_ledger_window",
     "obs_ledger_n": "obs_ledger_window",
+    "obs_utilization_freq": "obs_utilization_every",
+    "obs_roofline_every": "obs_utilization_every",
+    "obs_roofline_peaks_path": "obs_roofline_peaks",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -217,6 +220,8 @@ PARAMETER_SET = {
     "obs_data_profile",
     # cross-run performance ledger (obs/ledger.py)
     "obs_ledger_dir", "obs_ledger_suite", "obs_ledger_window",
+    # roofline attribution (obs/roofline.py)
+    "obs_utilization_every", "obs_roofline_peaks",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -677,6 +682,19 @@ class Config:
         # rolling-baseline window: median/MAD statistics cover the last
         # N comparable clean runs of the same (suite, shape, device) cell
         "obs_ledger_window": ("int", 8),
+        # roofline attribution (obs/roofline.py): emit a `utilization`
+        # rollup event every N iterations — exec-weighted achieved/peak
+        # FLOP and HBM-bandwidth fractions of every timed entry against
+        # the device-peak registry, dominant bound, headroom seconds.
+        # Implies obs_compile (the join needs cost estimates).  0 = off.
+        # Turns the observer on.
+        "obs_utilization_every": ("int", 0),
+        # JSON file of device-peak overrides for the roofline layer
+        # ({device_kind: {flops_f32, flops_bf16, hbm_bytes_per_s,
+        # ici_bytes_per_s, vmem_bytes}}), merged over the built-in
+        # table.  Empty = built-in peaks (unknown kinds fall back to a
+        # labelled CPU profile).
+        "obs_roofline_peaks": ("str", ""),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

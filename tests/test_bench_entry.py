@@ -40,9 +40,13 @@ def test_bench_orchestrator_end_to_end():
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "final_eval_metric", "final_eval_name",
-                        "construct_s"}
+                        "construct_s", "flop_util", "hbm_util"}
     assert rec["value"] > 0
     assert rec["construct_s"] is None or rec["construct_s"] >= 0
+    # roofline rollup: present when the timeline carried a utilization
+    # event (obs/roofline.py), null otherwise — never out of range
+    for k in ("flop_util", "hbm_util"):
+        assert rec[k] is None or 0.0 <= rec[k] <= 1.0
     assert rec["unit"] == "iters/sec"
     assert rec["final_eval_name"] == "auc"
     assert 0.0 < rec["final_eval_metric"] <= 1.0

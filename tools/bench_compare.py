@@ -106,6 +106,14 @@ METRICS = {
     # real orchestration creep (a new host sync, a regrown glue path),
     # which shows up as multiples, not percentages
     "host_orchestration_s": (-1, 0.50),
+    # roofline utilization rollups (schema v13, obs/roofline.py): the
+    # last `utilization` event's exec-weighted achieved/peak fractions.
+    # Higher is better — a drop means a kernel moved away from its
+    # hardware roof even if wall time hid it behind compile or host
+    # noise.  Utilization is a ratio of two timed quantities, so the
+    # tolerance is wider than it/s (timer noise enters twice)
+    "flop_util": (+1, 0.20),
+    "hbm_util": (+1, 0.20),
 }
 
 
@@ -192,6 +200,12 @@ def _from_timeline(events):
         out["rows_per_sec_per_chip"] = float(
             sc[-1]["rows_per_sec_per_chip"])
         out["weak_scaling_eff"] = float(sc[-1]["efficiency"])
+    # roofline rollup (schema v13): the LAST utilization event is the
+    # steady-state one — also in lockstep with metrics_from_events
+    utils = [e for e in events if e.get("ev") == "utilization"]
+    if utils and utils[-1].get("flop_util") is not None:
+        out["flop_util"] = float(utils[-1]["flop_util"])
+        out["hbm_util"] = float(utils[-1].get("hbm_util", 0.0))
     return out
 
 
@@ -214,6 +228,10 @@ def _from_parsed(parsed):
         out["serve_shed_rate"] = float(parsed["serve_shed_rate"])
     if parsed.get("construct_s") is not None:
         out["construct_s"] = float(parsed["construct_s"])
+    if parsed.get("flop_util") is not None:
+        out["flop_util"] = float(parsed["flop_util"])
+    if parsed.get("hbm_util") is not None:
+        out["hbm_util"] = float(parsed["hbm_util"])
     return out
 
 
@@ -452,6 +470,14 @@ def main(argv=None):
         "host_orchestration_s"][1],
         help="per-iteration host-orchestration seconds relative "
              "tolerance (schema v11; the fused-iteration gate)")
+    ap.add_argument("--tol-flop-util", type=float, default=METRICS[
+        "flop_util"][1],
+        help="achieved/peak FLOP-utilization relative tolerance "
+             "(schema v13 roofline rollups; higher is better)")
+    ap.add_argument("--tol-hbm-util", type=float, default=METRICS[
+        "hbm_util"][1],
+        help="achieved/peak HBM-bandwidth-utilization relative "
+             "tolerance (schema v13 roofline rollups)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable verdict on stdout")
     args = ap.parse_args(argv)
@@ -464,7 +490,9 @@ def main(argv=None):
             "serve_shed_rate": args.tol_serve_shed,
             "autotune_overhead_s": args.tol_autotune,
             "construct_s": args.tol_construct,
-            "host_orchestration_s": args.tol_host_orch}
+            "host_orchestration_s": args.tol_host_orch,
+            "flop_util": args.tol_flop_util,
+            "hbm_util": args.tol_hbm_util}
     try:
         base = load_metrics(args.baseline)
         cand = load_metrics(args.candidate)

@@ -242,6 +242,17 @@ NOTES = {
     "obs_ledger_window": "rolling-baseline window: median/MAD "
                          "statistics cover the last N comparable clean "
                          "runs of the same (suite, shape, device) cell",
+    "obs_utilization_every": "roofline attribution: emit a utilization "
+                             "rollup event every N iterations — "
+                             "achieved-vs-peak FLOP/s and HBM bandwidth "
+                             "plus a bound classification per jitted "
+                             "entry (implies obs_compile; 0 = off) — "
+                             "read back with `obs roofline`",
+    "obs_roofline_peaks": "JSON file overriding the device-peak "
+                          "registry (per device_kind: peak_flops_f32/"
+                          "bf16, peak_hbm_bytes, peak_ici_bytes, "
+                          "vmem_bytes); empty = built-in table with "
+                          "CPU fallback",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -324,7 +335,8 @@ GROUPS = [
         "obs_straggler_warn_skew", "obs_watchdog_secs", "obs_fsync",
         "obs_flight_events", "obs_split_audit", "obs_importance_every",
         "obs_importance_topk", "obs_data_profile", "obs_ledger_dir",
-        "obs_ledger_suite", "obs_ledger_window"]),
+        "obs_ledger_suite", "obs_ledger_window", "obs_utilization_every",
+        "obs_roofline_peaks"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",

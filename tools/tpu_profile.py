@@ -22,6 +22,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _obs_params(outdir):
+    """Timeline params for the profiled run: per-iteration fencing plus
+    compile-cost capture so the roofline table can be printed next to
+    the trace (the trace says WHERE the time goes, the roofline says how
+    far each entry sits from the hardware)."""
+    os.makedirs(outdir, exist_ok=True)
+    return {"obs_events_path": os.path.join(outdir, "obs_timeline.jsonl"),
+            "obs_timing": "iter", "obs_compile": True,
+            "obs_utilization_every": 1}
+
+
+def _print_roofline(gbdt, outdir):
+    gbdt._obs.close()
+    obs_path = os.path.join(outdir, "obs_timeline.jsonl")
+    try:
+        from lightgbm_tpu.obs import read_events
+        from lightgbm_tpu.obs.roofline import render_roofline
+        print()
+        render_roofline(read_events(obs_path))
+        print("timeline written to", obs_path,
+              "- rerun the table with: python -m lightgbm_tpu obs "
+              "roofline", obs_path)
+    except Exception as e:           # the trace must survive a table bug
+        print("tpu_profile: roofline table unavailable (%s)" % e,
+              file=sys.stderr)
+
+
 def main():
     argv = list(sys.argv[1:])
     shape = None
@@ -47,7 +74,7 @@ def main():
         from tools.bench_suite import SHAPES, cached_dataset
         spec = SHAPES[shape]
         train_set = cached_dataset(shape)
-        params = dict(spec["params"], verbose=-1)
+        params = dict(spec["params"], verbose=-1, **_obs_params(outdir))
         params.update(overrides)
         train_set.params = dict(train_set.params or {}, **params)
         bst = lgb.Booster(params=params, train_set=train_set)
@@ -60,6 +87,7 @@ def main():
                 gbdt.train_one_iter(None, None, False)
             jax.block_until_ready(gbdt._score_dev)
         print("trace written to", outdir)
+        _print_roofline(gbdt, outdir)
         return
 
     from tools.bench_modes import make_data
@@ -67,6 +95,7 @@ def main():
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
               "learning_rate": 0.1, "min_data_in_leaf": 1, "verbose": -1,
               "metric": "auc", "tpu_growth": "wave", "tpu_wave_width": 32}
+    params.update(_obs_params(outdir))
     params.update(overrides)
     bst = lgb.Booster(params=params,
                       train_set=lgb.Dataset(X, label=y, params=params))
@@ -82,6 +111,7 @@ def main():
     print("trace written to", outdir,
           "- open the .trace.json.gz in Perfetto (ui.perfetto.dev) or "
           "point TensorBoard's profile plugin at the directory")
+    _print_roofline(gbdt, outdir)
 
 
 if __name__ == "__main__":
