@@ -497,8 +497,56 @@ def dry():
               "obs_importance_every": 2,
               "obs_ledger_dir": ledger_dir,
               "obs_ledger_suite": "bench_dry",
-              "obs_utilization_every": 1}
-    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+              "obs_utilization_every": 1,
+              "obs_http_port": 0}
+
+    # live telemetry plane (obs/live.py): scrape all four endpoints
+    # MID-RUN — from a training callback, while the boosting loop is
+    # between iterations — and prove the scrape is free (fence count
+    # flat across it).  The observer tears the server down at run_end,
+    # so this is the only window the plane exists in.
+    import urllib.request
+    from lightgbm_tpu.obs import timers as obs_timers
+    live_scrapes = {}
+
+    def _scrape_live(env):
+        if env.iteration != env.begin_iteration + 2 or live_scrapes:
+            return
+        obs = env.model._gbdt._obs
+        url = obs.live_url
+        assert url.startswith("http://127.0.0.1:"), \
+            "obs_http_port=0 did not bind a loopback ephemeral port"
+        fences_before = obs_timers.fence_count()
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.status == 200 and "lgbm_train_iter_seconds" in body, \
+                "/metrics scrape missing training histogram"
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+            hz = json.loads(r.read().decode())
+            assert r.status == 200 and hz["status"] in ("ok", "warn"), \
+                "/healthz on a healthy mid-run: %r" % hz
+        with urllib.request.urlopen(url + "/statusz", timeout=5) as r:
+            sz = json.loads(r.read().decode())
+            assert sz["lifecycle"] == "train" and sz["last_it"] >= 1, \
+                "/statusz mid-run snapshot wrong: %r" % sz
+            assert sz["backend"] and sz["health"]["status"] == "ok", \
+                "/statusz missing header/health: %r" % sz
+        with urllib.request.urlopen(url + "/events?after=0",
+                                    timeout=5) as r:
+            lines = r.read().decode().strip().splitlines()
+            assert lines and int(r.headers["X-Obs-Next-After"]) >= \
+                len(lines), "/events tail empty mid-run"
+            assert any(json.loads(ln)["ev"] == "iter" for ln in lines), \
+                "/events tail carries no iter records"
+        assert obs_timers.fence_count() == fences_before, \
+            "scraping the live plane issued %d host sync(s) — " \
+            "observing must be free" \
+            % (obs_timers.fence_count() - fences_before)
+        live_scrapes.update(statusz=sz, events=len(lines))
+
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                    callbacks=[_scrape_live])
+    assert live_scrapes, "live-plane scrape callback never fired"
 
     # bucketed device predict: varying batch sizes must land on the
     # power-of-two executables (models/gbdt.py dispatch) — after one
@@ -516,6 +564,19 @@ def dry():
         "steady-state predict recompiled: %d jit entries after warmup " \
         "covered every bucket rung, %d after mixed-size traffic" \
         % (warm_entries, ranked_predict_device._cache_size())
+
+    # the live tail renders the same timeline the scrape served: --once
+    # must exit 0 and show per-iteration progress plus the run_end line
+    import io as _io
+    from lightgbm_tpu.obs.live import watch as obs_watch
+    watch_out = _io.StringIO()
+    assert obs_watch(obs_path, once=True, out=watch_out) == 0, \
+        "obs watch --once failed on the dry-run timeline"
+    watch_text = watch_out.getvalue()
+    assert "it 0" in watch_text and "it/s" in watch_text, \
+        "obs watch rendered no iteration progress:\n%s" % watch_text
+    assert "run end: status=ok" in watch_text, \
+        "obs watch missed the run_end record:\n%s" % watch_text
 
     evs = read_events(obs_path)          # validates every record
     kinds = [e["ev"] for e in evs]
@@ -705,6 +766,7 @@ def dry():
                       "utilization": len(util_recs),
                       "fused_iters": len(fused_iters),
                       "mid_tree_syncs": 0,
+                      "live_scrape_events": live_scrapes.get("events", 0),
                       "path": obs_path}))
 
 

@@ -182,10 +182,16 @@ def main(argv=None):
     from lightgbm_tpu.obs.ledger import default_ledger_dir
     ledger_dir = (default_ledger_dir() if args.ledger is None
                   else args.ledger)
+    # --dry also stands up the live telemetry plane (obs/live.py,
+    # port 0 = ephemeral): the scrape-under-load assert below proves the
+    # serving process exposes /statusz with the queue depth + SLO
+    # headline, and that being scraped sheds nothing and compiles
+    # nothing in steady state
     obs = RunObserver(events_path=obs_path, compile_attr=True,
                       ledger_dir=ledger_dir,
                       ledger_suite="serve_overload" if args.overload
-                      else "serve")
+                      else "serve",
+                      http_port=(0 if args.dry else None))
     obs.run_header(backend=jax.default_backend(),
                    devices=[str(d) for d in jax.local_devices()],
                    params={"requests": requests, "threads": args.threads,
@@ -236,6 +242,44 @@ def main(argv=None):
         if args.overload:
             lat, wall, offered, shed, nrows = run_overload(
                 sp, X, requests, args.threads, burst=24, sizes=sizes)
+        elif args.dry:
+            # scrape /statusz CONCURRENTLY with the load: the live plane
+            # reads host-side state only, so the data plane must not
+            # notice (the zero-shed / zero-steady-state-compile asserts
+            # in _dry_asserts run against exactly this scraped window)
+            import threading as _threading
+            import urllib.request as _urlreq
+            assert obs.live_url.startswith("http://127.0.0.1:"), \
+                "serve --dry: live plane did not bind"
+            scraped = {"n": 0}
+            stop_scrape = _threading.Event()
+
+            def _scraper():
+                while not stop_scrape.is_set():
+                    with _urlreq.urlopen(obs.live_url + "/statusz",
+                                         timeout=5) as r:
+                        scraped["last"] = json.loads(r.read().decode())
+                    scraped["n"] += 1
+                    time.sleep(0.02)
+
+            scr = _threading.Thread(target=_scraper, daemon=True)
+            scr.start()
+            try:
+                lat, wall, nrows = run_load(sp, X, requests,
+                                            args.threads, sizes)
+            finally:
+                stop_scrape.set()
+                scr.join(timeout=10)
+            offered, shed = len(lat), 0
+            assert scraped["n"] > 0, "statusz scraper never completed"
+            flight = (scraped.get("last") or {}).get("flight") or {}
+            assert "serve" in flight and \
+                "queue_depth" in flight["serve"], \
+                "/statusz under load missing serve queue state: %r" \
+                % flight
+            assert "slo" in flight and "targets" in flight["slo"], \
+                "/statusz under load missing the SLO headline: %r" \
+                % flight
         else:
             lat, wall, nrows = run_load(sp, X, requests, args.threads,
                                         sizes)

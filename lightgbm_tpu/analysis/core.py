@@ -34,13 +34,16 @@ PACKAGE_DIRNAME = "lightgbm_tpu"
 # loop, fused iteration, serving data plane, and the multi-host comm /
 # mesh layer — a stray sync there stalls EVERY rank at the next
 # collective, not just the offender).  obs/ is deliberately OUT of
-# scope — fencing is its job.
+# scope — fencing is its job — with one exception: the live scrape
+# plane (obs/live.py) promises "observing is free", so its server
+# thread must never touch device values; the pass proves it.
 HOT_PATH_PREFIXES = (
     "lightgbm_tpu/ops/",
     "lightgbm_tpu/models/gbdt.py",
     "lightgbm_tpu/serve/",
     "lightgbm_tpu/parallel/comm.py",
     "lightgbm_tpu/parallel/mesh.py",
+    "lightgbm_tpu/obs/live.py",
 )
 
 

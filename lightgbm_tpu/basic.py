@@ -877,6 +877,16 @@ class Booster:
                            else "warn"),
               "observer": self._gbdt._obs}
         kw.update(overrides)
+        # live telemetry plane (obs/live.py): a serving process exposes
+        # the same /metrics /healthz /statusz /events endpoints a
+        # training run does — the SLO headline and queue depth ride in
+        # through the observer's flight-provider registry
+        obs = kw.get("observer")
+        http_port = int(getattr(cfg, "obs_http_port", -1))
+        if http_port >= 0 and obs is not None and obs.enabled:
+            obs.ensure_live_server(
+                http_port, str(getattr(cfg, "obs_http_addr", "127.0.0.1")
+                               or "127.0.0.1"))
         return ServingPredictor(self._gbdt, num_iteration=num_iteration,
                                 **kw)
 

@@ -44,8 +44,15 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   intensity, a compute/memory/collective/host-orchestration bound and
   headroom seconds; emits the per-iteration ``utilization`` rollup
   (``obs_utilization_every``, schema 13) and stamps autotune probes;
+* ``live``    — the in-run live telemetry plane (``obs_http_port`` /
+  ``obs_http_addr``): a stdlib ThreadingHTTPServer daemon serving
+  ``/metrics`` (Prometheus), ``/healthz`` (200/503), ``/statusz``
+  (JSON run snapshot) and ``/events?after=N`` (ring-buffer JSONL tail)
+  from host-side observer state only — zero hot-path syncs — plus the
+  ``obs watch`` live-follow CLI over files, shard sets and URLs;
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
-  obs summary|recompiles|stragglers|explain|roofline|merge|diff|trace``;
+  obs summary|recompiles|stragglers|explain|roofline|merge|diff|trace|
+  watch``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -74,7 +81,8 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_watchdog_secs``, ``obs_flight_events``, ``obs_split_audit``,
 ``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``,
 ``obs_ledger_dir``, ``obs_ledger_suite``, ``obs_ledger_window``,
-``obs_utilization_every``, ``obs_roofline_peaks``.
+``obs_utilization_every``, ``obs_roofline_peaks``, ``obs_http_port``,
+``obs_http_addr``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -143,12 +151,15 @@ def observer_from_config(config, comm=None):
     ledger_dir = str(getattr(config, "obs_ledger_dir", "") or "")
     utilization_every = int(getattr(config, "obs_utilization_every", 0)
                             or 0)
+    # -1 = off; 0 is a real value (ephemeral port), so no `or` collapse
+    http_port = getattr(config, "obs_http_port", -1)
+    http_port = -1 if http_port is None else int(http_port)
     if (not events_path and not trace_iters and memory_every <= 0
             and health_mode == "off" and not metrics_path
             and metrics_every <= 0 and not compile_attr
             and straggler_every <= 0 and not split_audit
             and importance_every <= 0 and not ledger_dir
-            and utilization_every <= 0):
+            and utilization_every <= 0 and http_port < 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -203,4 +214,8 @@ def observer_from_config(config, comm=None):
                        utilization_every=utilization_every,
                        roofline_peaks=str(
                            getattr(config, "obs_roofline_peaks", "")
-                           or ""))
+                           or ""),
+                       http_port=(http_port if http_port >= 0 else None),
+                       http_addr=str(
+                           getattr(config, "obs_http_addr", "127.0.0.1")
+                           or "127.0.0.1"))

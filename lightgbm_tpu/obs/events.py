@@ -352,21 +352,40 @@ class RingBuffer:
     """Fixed-capacity ring of the most recent events — the flight
     recorder's view of "what was the run doing right before it died".
     Appends are lock-free (GIL-atomic deque ops) because the watchdog
-    thread snapshots while rank threads append."""
+    thread snapshots while rank threads append.
+
+    Every append is stamped with a process-lifetime monotonic sequence
+    number so the live /events endpoint (obs/live.py) can hand scrapers
+    a resumable cursor (``tail(after)``) instead of re-sending the
+    whole ring each poll.  The counter is best-effort under concurrent
+    appends — a duplicated seq costs a tailer one duplicate or skipped
+    event, never a corrupt record."""
 
     def __init__(self, capacity=256):
         self.capacity = max(1, int(capacity))
         self._buf = collections.deque(maxlen=self.capacity)
         self.dropped = 0
+        self._seq = 0
 
     def append(self, rec):
         if len(self._buf) == self._buf.maxlen:
             self.dropped += 1
-        self._buf.append(rec)
+        self._seq += 1
+        self._buf.append((self._seq, rec))
 
     def snapshot(self):
-        """List copy, oldest first."""
-        return list(self._buf)
+        """List copy of the records, oldest first."""
+        return [rec for _, rec in list(self._buf)]
+
+    @property
+    def last_seq(self):
+        return self._seq
+
+    def tail(self, after=0):
+        """(last_seq, records with seq > ``after``, oldest first) — the
+        cursor contract of the /events?after=N endpoint."""
+        items = list(self._buf)
+        return self._seq, [rec for s, rec in items if s > int(after)]
 
     def __len__(self):
         return len(self._buf)
@@ -496,6 +515,13 @@ class EventWriter:
     """Append-mode JSONL writer, flushed every ``flush_every`` events
     (and on close) so a killed run still leaves a readable timeline.
 
+    A monotonic-clock interval (``flush_interval_s``, ~1 s) flushes
+    alongside the count trigger: a live tailer (``obs watch``, the
+    /events endpoint's file-based cousins) sees events promptly during
+    slow iterations instead of up to ``flush_every`` events late.  The
+    clock is only consulted when an emit arrives — an idle writer costs
+    nothing.
+
     ``run_end`` is flushed UNCONDITIONALLY the moment it is emitted,
     whatever ``flush_every`` says — a crash right after finalize must
     not lose the one record every reader keys on.  ``fsync=True``
@@ -504,12 +530,15 @@ class EventWriter:
     death.  Emits are lock-serialized: the hang watchdog writes its
     final events from its own thread."""
 
-    def __init__(self, path, flush_every=16, fsync=False):
+    def __init__(self, path, flush_every=16, fsync=False,
+                 flush_interval_s=1.0):
         self.path = str(path)
         self.flush_every = max(1, int(flush_every))
+        self.flush_interval_s = max(0.0, float(flush_interval_s or 0.0))
         self.fsync = bool(fsync)
         self._f = None
         self._pending = 0
+        self._last_flush = time.monotonic()
         self._lock = threading.Lock()
 
     def emit(self, rec):
@@ -522,13 +551,17 @@ class EventWriter:
             self._f.write(json.dumps(rec, default=str) + "\n")
             self._pending += 1
             if self._pending >= self.flush_every \
-                    or rec.get("ev") == "run_end":
+                    or rec.get("ev") == "run_end" \
+                    or (self.flush_interval_s > 0.0
+                        and time.monotonic() - self._last_flush
+                        >= self.flush_interval_s):
                 self._flush_locked(sync=(self.fsync and
                                          rec.get("ev") == "run_end"))
 
     def _flush_locked(self, sync=False):
         self._f.flush()
         self._pending = 0
+        self._last_flush = time.monotonic()
         if sync:
             try:
                 os.fsync(self._f.fileno())
@@ -566,9 +599,16 @@ class NullObserver:
     rank = 0
     world_size = 1
     _closed = False
+    live_url = ""
 
     def event(self, ev, **fields):
         pass
+
+    def ensure_live_server(self, port, addr="127.0.0.1"):
+        return ""
+
+    def ring_tail(self, after=0):
+        return 0, []
 
     def watchdog_arm(self, label):
         pass
@@ -641,7 +681,8 @@ class RunObserver(NullObserver):
                  straggler_warn_skew=0.5, rank=None, world_size=None,
                  coordinator="", fsync=False, watchdog_secs=0.0,
                  flight_events=256, ledger_dir="", ledger_suite="",
-                 utilization_every=0, roofline_peaks=""):
+                 utilization_every=0, roofline_peaks="",
+                 http_port=None, http_addr="127.0.0.1"):
         from . import metrics as metrics_mod
         if rank is None or world_size is None:
             info = _default_rank_info()
@@ -705,9 +746,44 @@ class RunObserver(NullObserver):
             from .watchdog import Watchdog
             self._watchdog = Watchdog(self, float(watchdog_secs))
             self._watchdog.start()
+        # host-side live state the scrape plane (obs/live.py) reads: the
+        # server thread must never touch device values or fence
+        self._header = None
+        self._lifecycle = "startup"
+        self._last_it = None
+        self._ewma_iter_s = None
+        self._last_utilization = None
+        self._health_fatal = False
+        self._live = None
+        if http_port is not None and int(http_port) >= 0:
+            self.ensure_live_server(int(http_port), http_addr)
         # a killed run must still end in a flushed, parseable timeline
         atexit.register(self._finalize_at_exit)
         _register_observer(self)
+
+    # -- live telemetry plane (obs/live.py) -----------------------------
+    @property
+    def live_url(self):
+        """URL of the in-run scrape server, or "" when the plane is off."""
+        return self._live.url if self._live is not None else ""
+
+    def ensure_live_server(self, port, addr="127.0.0.1"):
+        """Start the live scrape server if it is not already up
+        (``Booster.serve()`` calls this so a serving process exposes the
+        same plane a training run does).  Returns the URL ("" when the
+        observer is closed or the bind failed)."""
+        if self._closed:
+            return ""
+        if self._live is not None:
+            return self._live.url
+        from .live import LiveServer
+        self._live = LiveServer(self, port, addr)
+        return self._live.start()
+
+    def ring_tail(self, after=0):
+        """(last_seq, records newer than ``after``) from the event ring
+        — the /events endpoint's cursor read."""
+        return self._ring.tail(after)
 
     # -- raw emission --------------------------------------------------
     def event(self, ev, **fields):
@@ -715,6 +791,12 @@ class RunObserver(NullObserver):
         if self.world_size > 1:
             rec["rank"] = self.rank
         rec.update(fields)
+        # live-state captures for the scrape plane: two string compares
+        # per event, host-only
+        if ev == "utilization":
+            self._last_utilization = rec
+        elif ev == "health" and fields.get("status") == "fatal":
+            self._health_fatal = True
         self.timeline.append(rec)
         self._ring.append(rec)
         if self._writer is not None:
@@ -722,15 +804,16 @@ class RunObserver(NullObserver):
         return rec
 
     def run_header(self, backend, devices, params, context):
-        self.event("run_header", schema=SCHEMA_VERSION, backend=backend,
-                   devices=devices, params=params, context=context,
-                   timing=self.timing, rank=self.rank,
-                   world_size=self.world_size,
-                   coordinator=self.coordinator,
-                   provenance=collect_provenance())
+        self._header = self.event(
+            "run_header", schema=SCHEMA_VERSION, backend=backend,
+            devices=devices, params=params, context=context,
+            timing=self.timing, rank=self.rank,
+            world_size=self.world_size, coordinator=self.coordinator,
+            provenance=collect_provenance())
 
     # -- per-iteration hooks ------------------------------------------
     def iter_begin(self, it):
+        self._lifecycle = "train"
         if self._watchdog is not None:
             self._watchdog.arm("iter %d" % it)
         self._trace.maybe_start(it, self)
@@ -746,6 +829,9 @@ class RunObserver(NullObserver):
         seq = self._seq
         self._seq += 1
         self._iters += 1
+        self._last_it = int(it)
+        self._ewma_iter_s = (total if self._ewma_iter_s is None
+                             else 0.7 * self._ewma_iter_s + 0.3 * total)
         self._m_iter_s.observe(total)
         self._m_iters.inc()
         self.event("iter", it=it, seq=seq, time_s=total, phases=phases,
@@ -892,6 +978,7 @@ class RunObserver(NullObserver):
     def close(self, status="ok"):
         if self._closed:
             return
+        self._lifecycle = "closed" if status == "ok" else "aborted"
         if status == "aborted" and not self._flight_dumped:
             # the flight record is the black box: write it BEFORE the
             # run_end path below can fail.  A record the watchdog (or
@@ -948,6 +1035,11 @@ class RunObserver(NullObserver):
             except Exception as e:
                 Log.warning("obs: ledger ingest into %s failed: %s",
                             self._ledger_dir, e)
+        # live plane teardown LAST: /healthz and /statusz stay
+        # scrapeable through finalize, then the ephemeral port frees
+        if self._live is not None:
+            self._live.stop()
+            self._live = None
 
     def _finalize_at_exit(self):
         """atexit hook: a run that never reached finalize (crash, sys.exit,

@@ -212,6 +212,15 @@ class HealthMonitors:
                       "(timeline has the health event)"
                       % ("/".join(fatal), it))
 
+    def verdict(self):
+        """Worst verdict recorded so far — the live /healthz signal
+        (obs/live.py): any fatal count makes the probe serve 503."""
+        if self.counts.get("fatal"):
+            return "fatal"
+        if self.counts.get("warn"):
+            return "warn"
+        return "ok"
+
     def summary(self):
         """Folded into run_end: verdict counts + per-device memory peaks."""
         out = {"mode": self.mode, "counts": dict(self.counts)}

@@ -45,7 +45,13 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
 * ``trend [LEDGER] [--check]`` — per-cell per-metric trend tables with
   sparklines and change-point attribution to the recorded git rev;
   ``--check`` exits 1 when any gated metric's current regime began
-  with a bad-direction shift — the cross-run CI gate.
+  with a bad-direction shift — the cross-run CI gate;
+* ``watch <timeline|url> [--once] [--ranks]`` — live-follow a GROWING
+  timeline (obs/live.py): iteration progress with an it/s sparkline,
+  compile/health/shed events and SLO verdicts as they happen; tails a
+  single file, every ``.rN`` shard of a pod run (``--ranks``, aligned
+  per iteration), or a running plane's ``/events`` URL
+  (``obs_http_port``); ``--once`` renders the current state and exits.
 
 Schema v1/v2 timelines load unchanged — the new event types simply
 don't appear.
@@ -55,6 +61,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .events import read_events
 
@@ -182,6 +189,17 @@ def timeline_metrics(events):
             out["stragglers"] = run_end["stragglers"]
         if "rank_report" in run_end:
             out["rank_report"] = run_end["rank_report"]
+    else:
+        # no run_end yet: a live run being tailed, not (necessarily) a
+        # crash — report in-progress with the last event's age instead
+        # of implying the run died (obs/live.py watch reads the same
+        # growing file)
+        out["status"] = "in_progress"
+        out["in_progress"] = True
+        last_t = max((float(e.get("t", 0.0)) for e in events),
+                     default=0.0)
+        if last_t:
+            out["last_event_age_s"] = max(0.0, time.time() - last_t)
     # serving timelines (bench_serve.py / ServingPredictor): fold the
     # serve_* events into a headline so `obs summary` has a serving
     # section instead of a zero-iteration shrug
@@ -206,6 +224,11 @@ def render_summary(events, out=None):
       % (m.get("run"), m.get("schema", "?"), m.get("backend", "?"),
          m.get("devices", "?"), m.get("timing", "?"),
          m.get("status", "?")))
+    if m.get("in_progress"):
+        age = m.get("last_event_age_s")
+        w("run in progress (last event %ss ago) — no run_end yet; "
+          "follow it live with `obs watch`"
+          % ("%.1f" % age if age is not None else "?"))
     if m.get("merged"):
         w("merged view of a %s-rank run" % m.get("world_size", "?"))
     elif m.get("world_size", 1) and int(m.get("world_size", 1) or 1) > 1:
@@ -662,6 +685,24 @@ def main(argv=None):
                    help="exit 1 when the timeline cannot be attributed "
                         "(no finished run, or no cost estimates — run "
                         "with obs_compile=true) — the CI gate")
+    p = sub.add_parser("watch",
+                       help="live-follow a growing timeline, per-rank "
+                            "shard set, or a running plane's /events "
+                            "URL (obs_http_port)")
+    p.add_argument("target",
+                   help="timeline file, shard base path, or "
+                        "http://host:port of a live run")
+    p.add_argument("--once", action="store_true",
+                   help="render everything currently visible and exit "
+                        "(the CI-friendly snapshot mode)")
+    p.add_argument("--ranks", action="store_true",
+                   help="tail every .rN shard of a pod run, aligning "
+                        "iterations across ranks (obs/merge.py)")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="poll interval in seconds (default 0.5)")
+    p.add_argument("--max-wall", type=float, default=0.0,
+                   help="follow-mode wall-clock limit in seconds for "
+                        "scripted callers (0 = no limit)")
     p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
                                      "of per-rank shards")
     p.add_argument("shards", nargs="+",
@@ -704,6 +745,13 @@ def main(argv=None):
                                 "regime began with a bad-direction "
                                 "shift — the cross-run CI gate")
     args = ap.parse_args(argv)
+
+    # watch targets may be URLs or shard-base globs, and the tailed
+    # file may end mid-line — it never goes through load_timeline
+    if args.cmd == "watch":
+        from .live import watch
+        return watch(args.target, once=args.once, ranks=args.ranks,
+                     interval_s=args.interval, max_wall_s=args.max_wall)
 
     if args.cmd in ("history", "trend"):
         from .ledger import Ledger, default_ledger_dir

@@ -132,6 +132,8 @@ class SloEngine:
         # by sec, pruned as they age past the long window
         self._routes = {}
         self._last_eval = clock()
+        self._last_overall = None      # most recent evaluated window
+        self._last_verdicts = {}
         self.alerting = False
         self.alerts_fired = 0
         self.alerts_cleared = 0
@@ -281,6 +283,9 @@ class SloEngine:
         if self.qps_target > 0:
             verdicts["qps"] = ("ok" if overall["qps"] >= self.qps_target
                                else "fail")
+        # host-side snapshot the live /statusz plane reads (obs/live.py)
+        self._last_overall = overall
+        self._last_verdicts = verdicts
         transition = None
         if self.p99_target_s > 0:
             if (not self.alerting and burn_short >= self.burn_threshold
@@ -354,6 +359,17 @@ class SloEngine:
                 "alerts_cleared": self.alerts_cleared,
                 "targets": {"p99_ms": self.p99_target_s * 1e3,
                             "qps": self.qps_target}}
+
+    def headline(self):
+        """Live one-dict SLO digest for /statusz (registered as a
+        flight provider by ServingPredictor): alert state + the most
+        recent evaluated window's overall stats and verdicts."""
+        out = self.summary()
+        if self._last_overall is not None:
+            out["overall"] = dict(self._last_overall)
+        if self._last_verdicts:
+            out["verdicts"] = dict(self._last_verdicts)
+        return out
 
     def close(self):
         """Final forced snapshot: a server that lived shorter than one

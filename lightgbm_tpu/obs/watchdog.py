@@ -76,6 +76,11 @@ def dump_flight_record(obs, reason, label=None, extra=None):
         "events": obs.ring_snapshot(),
         "threads": _thread_stacks(),
     }
+    # a live scrape plane (obs/live.py) outlives the hang that dumped
+    # this record — point the operator reading the dump at it
+    live = getattr(obs, "live_url", "")
+    if live:
+        record["live_url"] = live
     if extra:
         record["extra"] = dict(extra)
     # live-context providers (serve/scheduler.py: queue depth, queued

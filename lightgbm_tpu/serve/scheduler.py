@@ -483,6 +483,13 @@ class ServingPredictor:
             default_deadline_s=max(0.0, float(request_deadline_ms)) / 1e3,
             slo=self.slo, request_event_every=request_event_every,
             fault_hook=fault_hook)
+        # the SLO headline rides into flight records AND the live
+        # /statusz plane (obs/live.py) through the same provider
+        # registry the scheduler's queue state uses
+        self._slo_flight = None
+        if self.slo is not None:
+            self._slo_flight = lambda: {"slo": self.slo.headline()}
+            self.observer.add_flight_provider(self._slo_flight)
 
     # -------------------------------------------------------------- routes
     def _bucket_of(self, route, rows):
@@ -598,6 +605,9 @@ class ServingPredictor:
         snapshot, and the close-time watermarks in the metrics export.
         Idempotent."""
         self.scheduler.close()
+        if self._slo_flight is not None:
+            self.observer.remove_flight_provider(self._slo_flight)
+            self._slo_flight = None
         if self._summary_done:
             return
         self._summary_done = True

@@ -140,6 +140,10 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_utilization_freq": "obs_utilization_every",
     "obs_roofline_every": "obs_utilization_every",
     "obs_roofline_peaks_path": "obs_roofline_peaks",
+    "obs_http": "obs_http_port",
+    "obs_port": "obs_http_port",
+    "obs_http_host": "obs_http_addr",
+    "obs_http_address": "obs_http_addr",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -222,6 +226,8 @@ PARAMETER_SET = {
     "obs_ledger_dir", "obs_ledger_suite", "obs_ledger_window",
     # roofline attribution (obs/roofline.py)
     "obs_utilization_every", "obs_roofline_peaks",
+    # live telemetry plane (obs/live.py)
+    "obs_http_port", "obs_http_addr",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -695,6 +701,16 @@ class Config:
         # table.  Empty = built-in peaks (unknown kinds fall back to a
         # labelled CPU profile).
         "obs_roofline_peaks": ("str", ""),
+        # live telemetry plane (obs/live.py): HTTP port of the in-run
+        # scrape server (/metrics /healthz /statusz /events).  -1 = off
+        # (the default), 0 = bind an ephemeral port (reported via
+        # Booster telemetry and the run log), >0 = that port.  Turns
+        # the observer on.
+        "obs_http_port": ("int", -1),
+        # bind address of the live plane.  Loopback by default — the
+        # endpoints expose run params and provenance, so routing them
+        # off-host (e.g. 0.0.0.0 on a pod) is a deliberate choice.
+        "obs_http_addr": ("str", "127.0.0.1"),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

@@ -1,0 +1,385 @@
+"""Live observability plane (lightgbm_tpu/obs/live.py).
+
+Covers the in-run HTTP scrape server (all four endpoints, ephemeral
+port-0 binding, teardown at run_end, the /healthz 503 flip on a fatal
+health verdict, the /events cursor protocol), the `obs watch` live
+tail (single file, growing file with a concurrent writer, multi-rank
+shard set, URL mode), the opt-in default (no server unless
+obs_http_port is set), the EventWriter time-based flush, and the
+in-progress `obs summary` handling of a timeline with no run_end.
+"""
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lightgbm_tpu.obs import observer_from_config
+from lightgbm_tpu.obs.events import (NULL_OBSERVER, EventWriter,
+                                     RingBuffer, RunObserver)
+from lightgbm_tpu.obs.live import watch
+from lightgbm_tpu.obs.query import (load_timeline, main as query_main,
+                                    render_summary, timeline_metrics)
+from lightgbm_tpu.utils.config import Config
+
+
+def _get(url, timeout=5.0):
+    """(status, headers, body) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def _live_obs(tmp_path, **kw):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off", http_port=0, **kw)
+    assert obs.live_url.startswith("http://127.0.0.1:")
+    return obs
+
+
+def _run_a_bit(obs, iters=3):
+    obs.run_header("cpu", [{"id": 0, "kind": "cpu"}],
+                   {"num_leaves": 31}, {})
+    for it in range(iters):
+        obs.iter_begin(it)
+        obs.iter_end(it)
+
+
+# ---------------------------------------------------------------- server
+
+def test_port_zero_binds_ephemeral_and_tears_down(tmp_path):
+    obs = _live_obs(tmp_path)
+    url = obs.live_url
+    port = int(url.rsplit(":", 1)[1])
+    assert port > 0                      # 0 requested, real port bound
+    code, _, _ = _get(url + "/healthz")
+    assert code == 200
+    obs.close()
+    assert obs.live_url == ""
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=1.0)
+
+
+def test_metrics_endpoint_is_prometheus_text(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs)
+        code, headers, body = _get(obs.live_url + "/metrics")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "lgbm_train_iterations_total" in body
+        assert "# TYPE" in body
+    finally:
+        obs.close()
+
+
+def test_statusz_snapshot_schema(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs, iters=4)
+        code, _, body = _get(obs.live_url + "/statusz")
+        assert code == 200
+        s = json.loads(body)
+        assert s["lifecycle"] == "train"
+        assert s["iters"] == 4 and s["last_it"] == 3
+        assert s["backend"] == "cpu" and s["devices"] == 1
+        assert s["health"]["status"] == "ok"
+        assert s["ewma_iter_s"] > 0 and s["iters_per_sec"] > 0
+        assert s["ring"]["seq"] >= s["ring"]["len"] > 0
+        assert s["events_path"].endswith("ev.jsonl")
+    finally:
+        obs.close()
+
+
+def test_events_endpoint_cursor_protocol(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs, iters=2)
+        code, headers, body = _get(obs.live_url + "/events?after=0")
+        assert code == 200
+        assert headers["Content-Type"].startswith("application/x-ndjson")
+        recs = [json.loads(l) for l in body.splitlines()]
+        assert [r["ev"] for r in recs][:1] == ["run_header"]
+        cursor = int(headers["X-Obs-Next-After"])
+        assert cursor == len(recs)
+        # nothing newer than the cursor -> empty tail, same cursor
+        code, headers, body = _get(obs.live_url
+                                   + "/events?after=%d" % cursor)
+        assert code == 200 and body == ""
+        assert int(headers["X-Obs-Next-After"]) == cursor
+        # one more iteration -> exactly the new records
+        obs.iter_begin(2)
+        obs.iter_end(2)
+        _, headers, body = _get(obs.live_url + "/events?after=%d" % cursor)
+        new = [json.loads(l) for l in body.splitlines()]
+        assert all(r["ev"] == "iter" for r in new) and len(new) == 1
+    finally:
+        obs.close()
+
+
+def test_healthz_flips_503_on_fatal_health_event(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs)
+        code, _, body = _get(obs.live_url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        obs.event("health", check="loss_divergence", status="fatal",
+                  it=2, detail={"factor": 9.0})
+        code, _, body = _get(obs.live_url + "/healthz")
+        assert code == 503 and json.loads(body)["status"] == "fatal"
+        code, _, body = _get(obs.live_url + "/statusz")
+        assert json.loads(body)["health"]["status"] == "fatal"
+    finally:
+        obs.close()
+
+
+def test_unknown_route_404_and_index(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        code, _, _ = _get(obs.live_url + "/nope")
+        assert code == 404
+        code, _, body = _get(obs.live_url + "/")
+        assert code == 200
+        assert set(json.loads(body)["endpoints"]) == {
+            "/metrics", "/healthz", "/statusz", "/events"}
+    finally:
+        obs.close()
+
+
+def test_no_server_unless_param_set(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"), timing="off")
+    try:
+        assert obs.live_url == ""
+        assert obs._live is None
+    finally:
+        obs.close()
+    cfg = Config({"obs_events_path": str(tmp_path / "e2.jsonl")})
+    obs = observer_from_config(cfg)
+    try:
+        assert obs.live_url == ""
+    finally:
+        obs.close()
+    assert NULL_OBSERVER.live_url == ""
+    assert NULL_OBSERVER.ensure_live_server(0) == ""
+
+
+def test_http_port_alone_enables_observer(tmp_path):
+    cfg = Config({"obs_http_port": 0})
+    obs = observer_from_config(cfg)
+    try:
+        assert obs is not NULL_OBSERVER
+        assert obs.enabled
+        assert obs.live_url.startswith("http://127.0.0.1:")
+    finally:
+        obs.close()
+    # default stays the null observer
+    assert observer_from_config(Config({})) is NULL_OBSERVER
+
+
+def test_ensure_live_server_idempotent_and_closed_guard(tmp_path):
+    obs = _live_obs(tmp_path)
+    url = obs.live_url
+    assert obs.ensure_live_server(0) == url       # second call: same plane
+    obs.close()
+    assert obs.ensure_live_server(0) == ""        # closed observer: off
+
+
+# ---------------------------------------------------------------- ring
+
+def test_ring_tail_cursor():
+    ring = RingBuffer(capacity=4)
+    for i in range(6):                  # wraps: only 4 newest retained
+        ring.append({"ev": "iter", "it": i})
+    seq, recs = ring.tail(0)
+    assert seq == 6
+    assert [r["it"] for r in recs] == [2, 3, 4, 5]
+    seq2, recs2 = ring.tail(seq)
+    assert seq2 == 6 and recs2 == []
+    _, recs3 = ring.tail(4)
+    assert [r["it"] for r in recs3] == [4, 5]
+    # snapshot keeps its seq-free contract (flight records)
+    assert ring.snapshot()[-1] == {"ev": "iter", "it": 5}
+
+
+# ---------------------------------------------------------------- writer
+
+def test_event_writer_time_based_flush(tmp_path):
+    path = tmp_path / "t.jsonl"
+    w = EventWriter(path, flush_every=1000, flush_interval_s=0.05)
+    w.emit({"ev": "iter", "it": 0})     # within interval: may sit buffered
+    time.sleep(0.08)
+    w.emit({"ev": "iter", "it": 1})     # interval elapsed -> flush
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    w.close()
+
+
+def test_event_writer_interval_zero_disables_clock(tmp_path):
+    path = tmp_path / "t.jsonl"
+    w = EventWriter(path, flush_every=1000, flush_interval_s=0.0)
+    w.emit({"ev": "iter", "it": 0})
+    time.sleep(0.02)
+    w.emit({"ev": "iter", "it": 1})
+    assert path.read_text() == ""       # count trigger only
+    w.close()
+
+
+# ---------------------------------------------------------------- watch
+
+def _write_timeline(path, iters=3, run_end=True, rank=None):
+    with open(path, "w") as f:
+        hdr = {"ev": "run_header", "run": "r1", "schema": 13,
+               "backend": "cpu", "devices": [{"id": 0}], "params": {},
+               "context": {}, "timing": "off", "provenance": {},
+               "t": time.time()}
+        if rank is not None:
+            hdr["rank"], hdr["world_size"] = rank, 2
+        f.write(json.dumps(hdr) + "\n")
+        for it in range(iters):
+            rec = {"ev": "iter", "it": it, "run": "r1",
+                   "time_s": 0.01 * (1 + (rank or 0)), "phases": {},
+                   "fenced": False, "t": time.time()}
+            if rank is not None:
+                rec["rank"] = rank
+            f.write(json.dumps(rec) + "\n")
+        if run_end:
+            f.write(json.dumps({"ev": "run_end", "status": "ok",
+                                "iters": iters, "phase_totals": {},
+                                "entries": {}, "run": "r1",
+                                "t": time.time()}) + "\n")
+
+
+def test_watch_once_renders_snapshot(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    _write_timeline(path, iters=3)
+    out = io.StringIO()
+    assert watch(str(path), once=True, out=out) == 0
+    text = out.getvalue()
+    assert "run r1" in text and "backend cpu" in text
+    assert "it 0" in text and "it/s" in text
+    assert "run end: status=ok" in text
+
+
+def test_watch_once_while_writer_appends(tmp_path):
+    """--once against a timeline another thread is actively growing:
+    renders what is visible, tolerates a torn trailing line."""
+    path = tmp_path / "ev.jsonl"
+    stop = threading.Event()
+
+    def writer():
+        with open(path, "w") as f:
+            f.write(json.dumps({"ev": "run_header", "run": "r1",
+                                "schema": 13, "backend": "cpu",
+                                "devices": [], "timing": "off"}) + "\n")
+            f.flush()
+            it = 0
+            while not stop.is_set():
+                f.write(json.dumps({"ev": "iter", "it": it,
+                                    "time_s": 0.001}) + "\n")
+                f.flush()
+                it += 1
+                time.sleep(0.002)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 5.0
+        while not path.exists() and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)                 # let a few iters land
+        out = io.StringIO()
+        assert watch(str(path), once=True, out=out) == 0
+        text = out.getvalue()
+        assert "run r1" in text and "it 0" in text
+        assert "no events yet" not in text
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+
+
+def test_watch_follow_ends_at_run_end(tmp_path):
+    path = tmp_path / "ev.jsonl"
+
+    def writer():
+        time.sleep(0.05)
+        _write_timeline(path, iters=2, run_end=True)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    out = io.StringIO()
+    rc = watch(str(path), interval_s=0.05, out=out, max_wall_s=10.0)
+    t.join(timeout=5.0)
+    assert rc == 0
+    assert "run end: status=ok" in out.getvalue()
+
+
+def test_watch_once_empty_target(tmp_path):
+    out = io.StringIO()
+    assert watch(str(tmp_path / "missing.jsonl"), once=True, out=out) == 0
+    assert "no events yet" in out.getvalue()
+
+
+def test_watch_ranks_aligns_shards(tmp_path):
+    base = tmp_path / "ev.jsonl"
+    _write_timeline(str(base) + ".r0", iters=2, rank=0)
+    _write_timeline(str(base) + ".r1", iters=2, rank=1)
+    out = io.StringIO()
+    assert watch(str(base), once=True, ranks=True, out=out) == 0
+    text = out.getvalue()
+    assert "watching 2 shard(s)" in text
+    assert "r0 0.0100s" in text and "r1 0.0200s" in text
+    assert "skew" in text and "slowest r1" in text
+
+
+def test_watch_ranks_missing_shards_exit_2(tmp_path):
+    assert watch(str(tmp_path / "none.jsonl"), once=True, ranks=True,
+                 out=io.StringIO()) == 2
+
+
+def test_watch_url_mode_live_server(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs, iters=3)
+        out = io.StringIO()
+        assert watch(obs.live_url, once=True, out=out) == 0
+        text = out.getvalue()
+        assert "it 0" in text
+        assert "status: lifecycle train" in text    # /statusz footer
+    finally:
+        obs.close()
+
+
+def test_watch_cli_dispatch(tmp_path, capsys):
+    path = tmp_path / "ev.jsonl"
+    _write_timeline(path, iters=2)
+    assert query_main(["watch", str(path), "--once"]) == 0
+    assert "run end" in capsys.readouterr().out
+
+
+# --------------------------------------------------- in-progress summary
+
+def test_summary_reports_in_progress_without_run_end(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    _write_timeline(path, iters=3, run_end=False)
+    events = load_timeline(str(path))
+    m = timeline_metrics(events)
+    assert m["status"] == "in_progress"
+    assert m["in_progress"] is True
+    assert 0.0 <= m["last_event_age_s"] < 60.0
+    out = io.StringIO()
+    render_summary(events, out=out)
+    text = out.getvalue()
+    assert "run in progress" in text and "obs watch" in text
+
+
+def test_summary_finished_run_not_in_progress(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    _write_timeline(path, iters=3, run_end=True)
+    m = timeline_metrics(load_timeline(str(path)))
+    assert m.get("status") == "ok"
+    assert "in_progress" not in m

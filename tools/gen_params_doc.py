@@ -253,6 +253,18 @@ NOTES = {
                           "bf16, peak_hbm_bytes, peak_ici_bytes, "
                           "vmem_bytes); empty = built-in table with "
                           "CPU fallback",
+    "obs_http_port": "live telemetry plane: serve /metrics, /healthz, "
+                     "/statusz and /events?after=N over HTTP from a "
+                     "daemon thread for the life of the run (-1 = off, "
+                     "0 = ephemeral port — the bound port is logged and "
+                     "stamped into the flight record); turns the "
+                     "observer on by itself; zero hot-path syncs — "
+                     "follow live with `obs watch <url>`",
+    "obs_http_addr": "bind address for the live telemetry server; the "
+                     "127.0.0.1 default keeps the plane loopback-only — "
+                     "exposing it beyond the host (0.0.0.0) is a "
+                     "deliberate act, the endpoints carry params and "
+                     "provenance",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -336,7 +348,7 @@ GROUPS = [
         "obs_flight_events", "obs_split_audit", "obs_importance_every",
         "obs_importance_topk", "obs_data_profile", "obs_ledger_dir",
         "obs_ledger_suite", "obs_ledger_window", "obs_utilization_every",
-        "obs_roofline_peaks"]),
+        "obs_roofline_peaks", "obs_http_port", "obs_http_addr"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",
