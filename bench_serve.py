@@ -48,7 +48,7 @@ def build_model(rows, features, leaves, rounds):
               "verbose": -1}
     bst = lgb.train(params, lgb.Dataset(X, label=y),
                     num_boost_round=rounds)
-    return bst, np.asarray(X, np.float64)
+    return bst, np.asarray(X, np.float64), w
 
 
 def run_load(sp, X, requests, threads, sizes, seed=5):
@@ -132,6 +132,12 @@ def main(argv=None):
                     help="open-loop burst load against a small queue + "
                          "per-request deadline; asserts shed rate > 0, "
                          "bounded p99 of admitted, burn-rate alert")
+    ap.add_argument("--drift", action="store_true",
+                    help="drift drill: train on one distribution, serve "
+                         "a mean-shifted stream (drift alert MUST fire, "
+                         "`obs drift --check` exits 1) and an unshifted "
+                         "control (MUST stay clean, exits 0); control "
+                         "timeline lands at <obs-path>.control")
     ap.add_argument("--queue-limit", type=int, default=None,
                     help="scheduler queue limit in requests "
                          "(overload default 48)")
@@ -171,7 +177,10 @@ def main(argv=None):
     except OSError:
         pass
 
-    bst, X = build_model(rows, args.features, leaves, rounds)
+    bst, X, w = build_model(rows, args.features, leaves, rounds)
+
+    if args.drift:
+        return _drift_drill(bst, X, w, obs_path, args)
 
     # the serve run gets its OWN timeline (training closes its observer
     # when lgb.train returns): compile attribution lands here so `obs
@@ -320,6 +329,97 @@ def main(argv=None):
         "steady_state_compiles": ssc,
         "path": obs_path,
     }))
+
+
+def _drift_drill(bst, X, w, obs_path, args):
+    """The drift drill (``--dry --drift``): the model trained on
+    N(0,1)^d serves two streams through a drift-monitored
+    ServingPredictor — a mean-shifted one (the drift alert MUST fire;
+    ``obs drift --check`` exits 1 on its timeline) and an unshifted
+    i.i.d. control (zero alerts over the whole run; exits 0).  The
+    control also joins delayed labels so the ``online_quality`` channel
+    is exercised end-to-end.  Both sessions keep the PR-6/7 serve
+    guarantees: warmed rung ladder, zero steady-state compiles."""
+    import jax
+    from lightgbm_tpu.obs import RunObserver, read_events
+    from lightgbm_tpu.obs.drift import drift_metrics
+    from lightgbm_tpu.obs.ledger import default_ledger_dir
+    ledger_dir = (default_ledger_dir() if args.ledger is None
+                  else args.ledger)
+    control_path = obs_path + ".control"
+    rng = np.random.default_rng(23)
+    block, blocks = 256, 8
+    out = {}
+
+    for name, path in (("shifted", obs_path), ("control", control_path)):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        obs = RunObserver(events_path=path, compile_attr=True,
+                          ledger_dir=ledger_dir,
+                          ledger_suite="serve_drift_%s" % name)
+        obs.run_header(backend=jax.default_backend(),
+                       devices=[str(d) for d in jax.local_devices()],
+                       params={"stream": name, "block": block,
+                               "blocks": blocks},
+                       context={"tool": "bench_serve", "mode": "drift"})
+        with bst.serve(observer=obs, max_batch=block, max_delay_ms=1.0,
+                       drift_every=2 * block, drift_window=8 * block,
+                       drift_min_labels=64) as sp:
+            assert sp.drift is not None and sp.drift.enabled, \
+                "drift monitor did not come up (fingerprint missing?)"
+            if sp.cache is not None:
+                rungs, b = [], sp.cache.bucket_min
+                while b < sp.cache.max_batch:
+                    rungs.append(b)
+                    b <<= 1
+                rungs.append(sp.cache.max_batch)
+                sp.cache.warmup(rungs)
+                sp.cache.mark_warm()
+            futs = []
+            for i in range(blocks):
+                Xb = rng.normal(loc=2.0 if name == "shifted" else 0.0,
+                                size=(block, X.shape[1]))
+                ids = list(range(i * block, (i + 1) * block))
+                futs.append((Xb, ids, sp.submit(Xb, ids=ids)))
+            for _, _, f in futs:
+                f.result()
+            time.sleep(0.2)       # let score-capture callbacks land
+            if name == "control":
+                for Xb, ids, _ in futs[:2]:
+                    sp.record_outcome(
+                        ids, (Xb @ w > 0).astype(np.float64))
+            stats = sp.stats()
+        obs.close()
+
+        evs = read_events(path)   # validates every record (schema 14)
+        m = drift_metrics(evs)
+        assert m.get("present"), "%s timeline has no drift events" % name
+        ssc = (stats.get("executables") or {}).get(
+            "steady_state_compiles")
+        assert ssc == 0, \
+            "%s stream: steady state compiled %r executables" % (name,
+                                                                 ssc)
+        out[name] = {"psi_max": m.get("psi_max"),
+                     "alerts_fired": m["alerts"]["fired"]}
+        if name == "shifted":
+            assert m["alerts"]["fired"] > 0, \
+                "shifted stream fired no drift alert: %r" % m
+            warns = [e for e in evs if e["ev"] == "health"
+                     and e.get("check") == "drift"
+                     and e.get("status") == "warn"]
+            assert warns, "drift alert missing from the health channel"
+        else:
+            assert m["alerts"]["fired"] == 0, \
+                "control stream false-positived: %r" % m
+            oq = [e for e in evs if e["ev"] == "online_quality"]
+            assert oq, "control stream joined labels but emitted no " \
+                "online_quality event"
+            out[name]["online_auc"] = oq[-1].get("auc")
+
+    print(json.dumps({"status": "serve_drift_ok", "path": obs_path,
+                      "control_path": control_path, **out}))
 
 
 def _dry_asserts(bst, X, obs_path, steady_state_compiles, stats):

@@ -779,6 +779,35 @@ class Booster:
         observe_predict(rows, _time.perf_counter() - t0)
         return out
 
+    def _predict_drift(self):
+        """Lazy booster-level DriftMonitor (obs/drift.py) for the
+        synchronous predict path; the ServingPredictor builds its own.
+        Requires ``obs_drift_every`` > 0, an enabled observer and a
+        fingerprinted model; ``False`` caches 'checked, unavailable'."""
+        mon = self.__dict__.get("_drift_monitor")
+        if mon is not None:
+            return mon or None
+        cfg = self._cfg
+        obs = self._gbdt._obs
+        mon = False
+        if int(getattr(cfg, "obs_drift_every", 0) or 0) > 0 and obs.enabled:
+            fp = self._gbdt.drift_fingerprint()
+            if fp is not None:
+                from .obs.drift import DriftMonitor
+                m = DriftMonitor(
+                    fp, observer=obs,
+                    mode=(cfg.obs_health if cfg.obs_health != "off"
+                          else "warn"),
+                    every_rows=cfg.obs_drift_every,
+                    window_rows=cfg.obs_drift_window,
+                    psi_threshold=cfg.obs_drift_psi,
+                    topk=cfg.obs_drift_topk,
+                    min_labels=cfg.obs_drift_min_labels)
+                if m.enabled:
+                    mon = m
+        self._drift_monitor = mon
+        return mon or None
+
     def _predict_data(self, data, num_iteration, raw_score, pred_leaf,
                       pred_contrib, data_has_header,
                       pred_early_stop=False, pred_early_stop_freq=10,
@@ -795,16 +824,24 @@ class Booster:
                 raw_score=raw_score, early_stop=True,
                 early_stop_freq=pred_early_stop_freq,
                 early_stop_margin=pred_early_stop_margin)
+        drift = self._predict_drift()
 
         def run(block):
+            if drift is not None:
+                drift.observe_features(block)
             if early_predictor is not None:
-                return early_predictor._predict_impl(block)
-            if pred_contrib:
+                out = early_predictor._predict_impl(block)
+            elif pred_contrib:
                 return self._gbdt.pred_contrib(block,
                                                num_iteration=num_iteration)
-            return self._gbdt.predict(block, num_iteration=num_iteration,
-                                      raw_score=raw_score,
-                                      pred_leaf=pred_leaf)
+            else:
+                out = self._gbdt.predict(block,
+                                         num_iteration=num_iteration,
+                                         raw_score=raw_score,
+                                         pred_leaf=pred_leaf)
+            if drift is not None and not pred_leaf:
+                drift.observe_scores(out, raw=raw_score)
+            return out
 
         if isinstance(data, str):
             from .io import parser as _parser
@@ -852,9 +889,14 @@ class Booster:
         ``batch_event_every``, ``queue_limit``,
         ``request_deadline_ms``, ``request_event_every``,
         ``slo_p99_ms``, ``slo_qps``, ``slo_window_s``, ``slo_every_s``,
-        ``slo_mode``, ``num_features``, ``devices``).  Close it (or use
-        as a context manager) to flush the queue, stop the worker
-        thread and leave the ``serve_summary`` lifetime record.
+        ``slo_mode``, ``drift_every``, ``drift_window``, ``drift_psi``,
+        ``drift_topk``, ``drift_min_labels``, ``num_features``,
+        ``devices``).  With ``obs_drift_every`` > 0 and a fingerprinted
+        model, a DriftMonitor watches the submitted traffic for
+        distribution shift vs the training-time reference
+        (docs/Observability.md, "Drift & online quality").  Close it
+        (or use as a context manager) to flush the queue, stop the
+        worker thread and leave the ``serve_summary`` lifetime record.
         """
         from .serve import ServingPredictor
         cfg = self._cfg
@@ -875,6 +917,11 @@ class Booster:
               # breach must never be silent once targets are set)
               "slo_mode": (cfg.obs_health if cfg.obs_health != "off"
                            else "warn"),
+              "drift_every": cfg.obs_drift_every,
+              "drift_window": cfg.obs_drift_window,
+              "drift_psi": cfg.obs_drift_psi,
+              "drift_topk": cfg.obs_drift_topk,
+              "drift_min_labels": cfg.obs_drift_min_labels,
               "observer": self._gbdt._obs}
         kw.update(overrides)
         # live telemetry plane (obs/live.py): a serving process exposes

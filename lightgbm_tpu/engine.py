@@ -172,13 +172,17 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 # metric values double as timeline `eval` events — the
                 # convergence/overfit-gap surface for `obs explain` and
                 # bench_compare's final_eval_metric gate (the CLI path
-                # gets the same events from GBDT.output_metric)
-                obs = booster._gbdt._obs
-                if obs.enabled and evaluation_result_list:
-                    obs.event("eval", it=i, results=[
-                        {"dataset": str(n), "metric": str(m),
-                         "value": float(v)}
-                        for n, m, v, _ in evaluation_result_list])
+                # gets the same events from GBDT.output_metric) — and as
+                # the drift fingerprint's eval snapshot (obs/drift.py)
+                if evaluation_result_list:
+                    results = [{"dataset": str(n), "metric": str(m),
+                                "value": float(v)}
+                               for n, m, v, _ in evaluation_result_list]
+                    booster._gbdt._last_eval_results = results
+                    booster._gbdt._drift_fingerprint = None
+                    obs = booster._gbdt._obs
+                    if obs.enabled:
+                        obs.event("eval", it=i, results=results)
             try:
                 for cb in cbs_after:
                     cb(callback_mod.CallbackEnv(model=booster, params=params,

@@ -67,6 +67,10 @@ class TrainingData:
         # data-quality profile of the binning sample (obs/dataquality.py);
         # None when binning was copied/loaded rather than fitted here
         self._data_profile: Optional[dict] = None
+        # per-feature drift fingerprint of the binning sample
+        # (obs/drift.py feature_fingerprint) — the serving-time
+        # reference; completed with score/eval snapshots by the GBDT
+        self._drift_fingerprint: Optional[dict] = None
         # construction-phase accounting for the `dataset_construct` obs
         # event (rows, chunks, phase seconds, peak RSS, workers)
         self._construct_stats: Optional[dict] = None
@@ -607,6 +611,11 @@ class TrainingData:
                 "%d feature(s) binned into a single bucket (constant, "
                 "never splittable): %s%s", len(single), head,
                 ",..." if len(single) > 20 else "")
+        if bool(getattr(config, "obs_drift_fingerprint", True)):
+            from ..obs import drift
+            self._drift_fingerprint = drift.feature_fingerprint(
+                self.bin_mappers, get_col, self.num_total_features,
+                sample_size, self.feature_names)
         if not bool(getattr(config, "obs_data_profile", True)):
             return
         from ..obs import dataquality
@@ -861,6 +870,7 @@ class TrainingData:
         self.max_bin = int(h["max_bin"])
         self.bin_mappers = [None if d is None else BinMapper.from_dict(d)
                             for d in h["bin_mappers"]]
+        self._drift_fingerprint = h.get("drift_fingerprint")
         self._build_feature_arrays()
         groups = h.get("bundle_groups")
         if groups is not None:

@@ -27,6 +27,7 @@ Scores layout is the reference's column-major flat array, shaped
 """
 from __future__ import annotations
 
+import json
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -106,6 +107,11 @@ class GBDT:
         self._score_host: Optional[np.ndarray] = None
         self._obs = NULL_OBSERVER
         self._metrics = None
+        # serving-time drift reference (obs/drift.py): lazily completed
+        # from the dataset fingerprint + train scores + last eval, or
+        # restored verbatim from the model text header
+        self._drift_fingerprint: Optional[dict] = None
+        self._last_eval_results: List[dict] = []
         # lazily-resolved fused iteration (ops/fused_iter.py): None =
         # unresolved; (obj_or_None,) = resolved.  Invalidated whenever
         # the learner / objective / observer it binds is rebuilt.
@@ -894,8 +900,9 @@ class GBDT:
         meet_pairs: List[Tuple[int, int]] = []
         # metric values double as timeline `eval` events (convergence /
         # overfit-gap surface for `obs explain` and bench_compare's
-        # final_eval_metric gate); None when the observer is off
-        eval_results = [] if self._obs.enabled else None
+        # final_eval_metric gate) and as the drift fingerprint's eval
+        # snapshot — always collected; only the event is observer-gated
+        eval_results: List[dict] = []
         if need_output:
             for m in self.training_metrics:
                 scores = self._reduce_scores(
@@ -935,7 +942,10 @@ class GBDT:
                         elif it - self.best_iter[i][j] >= self.early_stopping_round:
                             ret = self.best_msg[i][j]
         if eval_results:
-            self._obs.event("eval", it=it, results=eval_results)
+            self._last_eval_results = eval_results
+            self._drift_fingerprint = None   # eval snapshot went stale
+            if self._obs.enabled:
+                self._obs.event("eval", it=it, results=eval_results)
         msg = "\n".join(msg_lines)
         for i, j in meet_pairs:
             self.best_msg[i][j] = msg
@@ -1146,6 +1156,29 @@ class GBDT:
     def sub_model_name(self) -> str:
         return "tree"
 
+    def drift_fingerprint(self) -> Optional[dict]:
+        """Serving-time drift reference (obs/drift.py): the dataset's
+        per-feature binned histograms completed with the training-score
+        distribution(s) and the final eval snapshot.  Cached — each
+        eval pass invalidates it — and restored verbatim when the model
+        was loaded from text, so a serving process never needs the
+        training dataset."""
+        if self._drift_fingerprint is not None:
+            return self._drift_fingerprint
+        td = getattr(self, "train_data", None)
+        base = getattr(td, "_drift_fingerprint", None)
+        if base is None:
+            return None
+        from ..obs import drift
+        try:
+            score = self.train_score
+        except Exception:            # score engine not stood up yet
+            score = None
+        self._drift_fingerprint = drift.attach_scores(
+            base, train_score=score, objective=self.objective,
+            eval_results=self._last_eval_results)
+        return self._drift_fingerprint
+
     def save_model_to_string(self, num_iteration: int = -1) -> str:
         """GBDT::SaveModelToString (gbdt.cpp:817-861)."""
         self._materialize()
@@ -1160,6 +1193,14 @@ class GBDT:
             lines.append("boost_from_average")
         lines.append("feature_names=" + " ".join(self.feature_names))
         lines.append("feature_infos=" + " ".join(self.feature_infos))
+        fp = self.drift_fingerprint()
+        if fp is not None:
+            # one compact-JSON header line (no newlines, so it survives
+            # parse_kv_lines round trips); any process loading the model
+            # text gets the serving-time drift reference for free
+            lines.append("drift_fingerprint=%s"
+                         % json.dumps(fp, sort_keys=True,
+                                      separators=(",", ":")))
         lines.append("")
         num_used = self._used_trees(num_iteration)
         for i in range(num_used):
@@ -1208,6 +1249,12 @@ class GBDT:
             self.feature_infos = kv["feature_infos"].split(" ")
         if "objective" in kv:
             self.objective = load_objective_from_string(kv["objective"])
+        if "drift_fingerprint" in kv:
+            try:
+                self._drift_fingerprint = json.loads(kv["drift_fingerprint"])
+            except ValueError as e:
+                Log.warning("ignoring malformed drift_fingerprint in "
+                            "model text: %s", e)
         # tree blocks
         text = "\n".join(lines)
         parts = text.split("Tree=")

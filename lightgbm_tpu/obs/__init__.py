@@ -50,9 +50,21 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   (JSON run snapshot) and ``/events?after=N`` (ring-buffer JSONL tail)
   from host-side observer state only — zero hot-path syncs — plus the
   ``obs watch`` live-follow CLI over files, shard sets and URLs;
+* ``drift``   — drift & online model-quality monitoring: at training
+  time a per-feature binned fingerprint of the data world (histograms
+  from the BinMapper sample + frozen mappers + training-score
+  distribution + final eval snapshot) persists with the model text and
+  the binned dataset dir; at serving time a ``DriftMonitor`` bins
+  incoming traffic with the same frozen mappers into rolling windows,
+  computing PSI/KS per feature and for the score distribution every
+  ``obs_drift_every`` rows (schema-14 ``drift`` events,
+  ``lgbm_drift_psi`` gauges, obs_health alerts), counts non-finite /
+  out-of-range input anomalies, and joins delayed labels
+  (``ServingPredictor.record_outcome``) into rolling online
+  AUC/logloss vs the training reference (``online_quality`` events);
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
-  obs summary|recompiles|stragglers|explain|roofline|merge|diff|trace|
-  watch``;
+  obs summary|recompiles|stragglers|explain|roofline|serve|drift|
+  merge|diff|trace|watch``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -82,7 +94,9 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_importance_every``, ``obs_importance_topk``, ``obs_data_profile``,
 ``obs_ledger_dir``, ``obs_ledger_suite``, ``obs_ledger_window``,
 ``obs_utilization_every``, ``obs_roofline_peaks``, ``obs_http_port``,
-``obs_http_addr``.
+``obs_http_addr``, ``obs_drift_every``, ``obs_drift_window``,
+``obs_drift_psi``, ``obs_drift_fingerprint``, ``obs_drift_topk``,
+``obs_drift_min_labels``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -129,7 +143,8 @@ def observer_from_config(config, comm=None):
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
     ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every`` /
     ``obs_split_audit`` / ``obs_importance_every`` / ``obs_ledger_dir`` /
-    ``obs_utilization_every`` enables the observer; health, metrics, compile and model tracking
+    ``obs_utilization_every`` / ``obs_drift_every`` enables the
+    observer; health, metrics, compile and model tracking
     work without an events path (in-memory timeline via
     Booster.telemetry()).  A non-empty ``obs_ledger_dir`` additionally
     ingests the finished run into the cross-run ledger on clean close.
@@ -151,6 +166,7 @@ def observer_from_config(config, comm=None):
     ledger_dir = str(getattr(config, "obs_ledger_dir", "") or "")
     utilization_every = int(getattr(config, "obs_utilization_every", 0)
                             or 0)
+    drift_every = int(getattr(config, "obs_drift_every", 0) or 0)
     # -1 = off; 0 is a real value (ephemeral port), so no `or` collapse
     http_port = getattr(config, "obs_http_port", -1)
     http_port = -1 if http_port is None else int(http_port)
@@ -159,7 +175,8 @@ def observer_from_config(config, comm=None):
             and metrics_every <= 0 and not compile_attr
             and straggler_every <= 0 and not split_audit
             and importance_every <= 0 and not ledger_dir
-            and utilization_every <= 0 and http_port < 0):
+            and utilization_every <= 0 and http_port < 0
+            and drift_every <= 0):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:

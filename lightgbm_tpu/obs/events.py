@@ -104,7 +104,7 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
@@ -113,13 +113,16 @@ SCHEMA_VERSION = 13
 # field — schema 11 adds the host-glue seconds between device program
 # submissions, models/gbdt.py OrchestrationClock), 11 (no pod
 # scale-out events — schema 12 adds scaling / mesh_shrink / checkpoint
-# and the sharded-ingest dataset_construct fields) and 12 (no roofline
+# and the sharded-ingest dataset_construct fields), 12 (no roofline
 # attribution — schema 13 adds the per-iteration ``utilization``
 # rollup and the ``autotune_probe.roofline`` cell stamp, obs/
-# roofline.py) timelines still parse.  wave_band_escape stays accepted
-# for old timelines even though nothing emits it anymore (the band
-# prior died in PR-11; ops/pallas_wave.py tile planner post-mortem).
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+# roofline.py) and 13 (no drift monitoring — schema 14 adds the
+# ``drift`` / ``online_quality`` serving-side distribution-shift
+# events and the serve_summary ``drift`` digest, obs/drift.py)
+# timelines still parse.  wave_band_escape stays accepted for old
+# timelines even though nothing emits it anymore (the band prior died
+# in PR-11; ops/pallas_wave.py tile planner post-mortem).
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -186,6 +189,13 @@ _REQUIRED = {
     # joined from CompileTracker cost estimates and the device-peak
     # registry (obs_utilization_every)
     "utilization": ("it", "entries"),
+    # schema 14 (obs/drift.py): serving-side drift monitoring — one
+    # ``drift`` rollup per obs_drift_every rows (per-feature + score
+    # PSI/KS vs the training fingerprint), one ``online_quality`` per
+    # evaluation once enough delayed labels joined via
+    # ServingPredictor.record_outcome
+    "drift": ("rows", "window_rows", "psi_max"),
+    "online_quality": ("n", "logloss"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -240,7 +250,7 @@ _OPTIONAL = {
     "serve_slo": ("short_s", "overall", "alert", "burn_short",
                   "burn_long", "targets", "verdicts"),
     "serve_summary": ("pad_rows", "max_queue_depth", "requests", "shed",
-                      "executables", "slo"),
+                      "executables", "slo", "drift"),
     # schema 13: every probed cell carries its analytic roofline stamp
     # (flop/hbm utilization at the measured s/wave, dominant bound) so
     # `obs explain` can say why the winner won — obs/roofline.py
@@ -262,6 +272,12 @@ _OPTIONAL = {
     "checkpoint": ("path", "bytes", "world_size"),
     "utilization": ("flop_util", "hbm_util", "bound", "headroom_s",
                     "device_kind", "roof_source"),
+    # schema 14: the drift rollup carries its top-k feature evidence
+    # (per-feature psi/ks + most-shifted bins), the score-space
+    # divergence, the input-anomaly counters and the alert state
+    "drift": ("score_psi", "features", "score", "anomalies",
+              "threshold", "alert"),
+    "online_quality": ("auc", "pending", "ref_auc", "ref_logloss"),
     "run_end": ("status", "health", "compile_attr", "stragglers",
                 # obs/merge.py merged-timeline summary
                 "rank_report"),

@@ -37,8 +37,12 @@ MODES = ("off", "warn", "fatal")
 # checks that never abort the run even under obs_health=fatal: a flat
 # loss is a tuning smell, not a poisoned run, and an SLO burn-rate alert
 # (obs/serve.py) is a paging signal for operators — killing the server
-# that is already missing latency targets only makes the outage total
-_WARN_ONLY = frozenset(("plateau", "slo_burn_rate"))
+# that is already missing latency targets only makes the outage total.
+# Drift and input anomalies (obs/drift.py) are retrain signals for the
+# continuous-training loop for the same reason: the model still serves,
+# it just serves traffic it was not trained on.
+_WARN_ONLY = frozenset(("plateau", "slo_burn_rate", "drift",
+                        "serve_input", "online_quality"))
 
 _PLATEAU_REL = 1e-4
 

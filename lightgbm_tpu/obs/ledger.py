@@ -79,6 +79,13 @@ METRIC_DIRECTIONS = {
     # its roof, the regression class the roofline layer exists to catch
     "flop_util": +1,
     "hbm_util": +1,
+    # schema 14 drift monitoring (obs/drift.py): the worst per-feature
+    # PSI vs the training fingerprint and the rolling online quality —
+    # `obs trend` attributes drift onset to the window whose cell
+    # first shifted
+    "drift_psi_max": -1,
+    "online_auc": +1,
+    "online_logloss": -1,
 }
 
 # noise floors under the MAD estimate: a flat history has MAD 0, and a
@@ -174,6 +181,19 @@ def metrics_from_events(events):
     if utils and utils[-1].get("flop_util") is not None:
         out["flop_util"] = float(utils[-1]["flop_util"])
         out["hbm_util"] = float(utils[-1].get("hbm_util", 0.0))
+    # schema 14: the run's WORST drift evaluation (not the last — a
+    # window that drifted and then reset must still mark the run) and
+    # the last online-quality rollup
+    drifts = [e for e in events if e.get("ev") == "drift"]
+    if drifts:
+        out["drift_psi_max"] = max(float(e.get("psi_max", 0.0))
+                                   for e in drifts)
+    quality = [e for e in events if e.get("ev") == "online_quality"]
+    if quality:
+        if quality[-1].get("auc") is not None:
+            out["online_auc"] = float(quality[-1]["auc"])
+        if quality[-1].get("logloss") is not None:
+            out["online_logloss"] = float(quality[-1]["logloss"])
     return out
 
 

@@ -427,6 +427,31 @@ class WatchRenderer:
             if rec.get("alert") == "firing":
                 bits.append("ALERT FIRING")
             self._w(tag + "slo: " + "  ".join(bits))
+        elif ev == "drift":
+            feats = rec.get("features") or []
+            top = feats[0] if feats else None
+            bits = ["psi_max %.3f" % float(rec.get("psi_max", 0.0))]
+            if top:
+                bits.append("top %s (psi %.3f)"
+                            % (top.get("feature"),
+                               float(top.get("psi", 0.0))))
+            if rec.get("score_psi") is not None:
+                bits.append("score %.3f" % float(rec["score_psi"]))
+            bits.append("rows %s" % rec.get("rows"))
+            # the WARN styling of the health lines: alerting windows
+            # shout, stable ones stay lowercase
+            head = ("DRIFT[warn] " if rec.get("alert") == "firing"
+                    else "drift: ")
+            self._w(tag + head + "  ".join(bits))
+        elif ev == "online_quality":
+            bits = ["n %s" % rec.get("n")]
+            if rec.get("auc") is not None:
+                bits.append("auc %.4f" % float(rec["auc"]))
+                if rec.get("ref_auc") is not None:
+                    bits.append("(train %.4f)" % float(rec["ref_auc"]))
+            if rec.get("logloss") is not None:
+                bits.append("logloss %.4f" % float(rec["logloss"]))
+            self._w(tag + "online: " + "  ".join(bits))
         elif ev == "serve_summary":
             shed = int(rec.get("shed_total", 0))
             self._w("%sserve: %s batches  %s rows  shed %d%s"
@@ -481,6 +506,13 @@ class WatchRenderer:
                 bits.append("p99 %.2fms" % (1e3 * overall["p99_s"]))
             if slo.get("alerting"):
                 bits.append("SLO ALERT")
+        drift = (status.get("flight") or {}).get("drift")
+        if drift:
+            last = drift.get("last") or {}
+            if last.get("psi_max") is not None:
+                bits.append("drift psi %.3f" % float(last["psi_max"]))
+            if drift.get("alerting"):
+                bits.append("DRIFT ALERT")
         self._w("status: " + "  ".join(bits))
 
 

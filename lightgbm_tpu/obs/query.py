@@ -30,6 +30,12 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   summary and batch efficiency; ``--check`` exits 1 on any shed
   request, fired burn-rate alert or failing SLO verdict — the CI gate
   that non-overload load stays shed-free;
+* ``drift RUN.jsonl``         — drift & online-quality report
+  (obs/drift.py): features ranked by PSI/KS divergence vs the training
+  fingerprint with a train-vs-serve histogram diff table, score-space
+  divergence, input-anomaly counts and rolling online AUC/logloss;
+  ``--check`` exits 1 on a fired drift alert (or a timeline with no
+  drift events at all) — the CI drift-drill gate;
 * ``merge RUN.jsonl [-o M.jsonl]`` — discover the per-rank shards of a
   distributed run (``RUN.jsonl.r0`` ...), align them on iteration /
   collective ``seq`` (obs/merge.py), print per-collective barrier skew,
@@ -673,6 +679,15 @@ def main(argv=None):
                    help="exit 1 on shed requests, fired burn-rate "
                         "alerts or failing SLO verdicts — the CI gate "
                         "for non-overload load")
+    p = sub.add_parser("drift", help="drift & online-quality report: "
+                                     "features ranked by divergence vs "
+                                     "the training fingerprint, score "
+                                     "PSI/KS, online AUC/logloss")
+    p.add_argument("timeline")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on a fired drift alert or a timeline "
+                        "with no drift events — the CI drift-drill "
+                        "gate")
     p = sub.add_parser("roofline",
                        help="achieved-vs-peak utilization per jitted "
                             "entry, ranked by recoverable headroom "
@@ -807,6 +822,11 @@ def main(argv=None):
     elif args.cmd == "serve":
         from .serve import render_serve_report
         problems = render_serve_report(events, check=args.check)
+        if args.check and problems:
+            return 1
+    elif args.cmd == "drift":
+        from .drift import render_drift_report
+        problems = render_drift_report(events, check=args.check)
         if args.check and problems:
             return 1
     elif args.cmd == "roofline":

@@ -447,7 +447,10 @@ class ServingPredictor:
                  request_event_every: int = 0, slo_p99_ms: float = 0.0,
                  slo_qps: float = 0.0, slo_window_s: float = 60.0,
                  slo_every_s: float = 10.0, slo_mode: str = "warn",
-                 fault_hook=None):
+                 fault_hook=None, drift_every: int = 0,
+                 drift_window: int = 8192, drift_psi: float = 0.2,
+                 drift_topk: int = 10, drift_min_labels: int = 100,
+                 drift_fingerprint=None, drift_mode: str = None):
         from .executable import PredictExecutableCache
         self.gbdt = gbdt
         self.num_iteration = int(num_iteration)
@@ -475,6 +478,30 @@ class ServingPredictor:
             self.slo = SloEngine(
                 observer=self.observer, mode=slo_mode, p99_ms=slo_p99_ms,
                 qps=slo_qps, window_s=slo_window_s, every_s=slo_every_s)
+        # drift monitor only when asked for AND the model carries a
+        # training-time fingerprint to compare against (obs/drift.py);
+        # like the SLO engine, absent means the hot path is unchanged
+        self.drift = None
+        if int(drift_every or 0) > 0:
+            fp = (drift_fingerprint if drift_fingerprint is not None
+                  else gbdt.drift_fingerprint())
+            if fp is None:
+                Log.warning("serve: obs_drift_every=%d but the model "
+                            "has no drift fingerprint (trained before "
+                            "schema 14, or obs_drift_fingerprint=false)"
+                            "; drift monitoring disabled",
+                            int(drift_every))
+            else:
+                from ..obs.drift import DriftMonitor
+                mon = DriftMonitor(
+                    fp, observer=self.observer,
+                    mode=(drift_mode if drift_mode is not None
+                          else slo_mode),
+                    every_rows=drift_every, window_rows=drift_window,
+                    psi_threshold=drift_psi, topk=drift_topk,
+                    min_labels=drift_min_labels)
+                if mon.enabled:
+                    self.drift = mon
         self.scheduler = MicrobatchScheduler(
             self._run_route, max_batch=max_batch,
             max_delay_ms=max_delay_ms, observer=self.observer,
@@ -490,6 +517,10 @@ class ServingPredictor:
         if self.slo is not None:
             self._slo_flight = lambda: {"slo": self.slo.headline()}
             self.observer.add_flight_provider(self._slo_flight)
+        self._drift_flight = None
+        if self.drift is not None:
+            self._drift_flight = lambda: {"drift": self.drift.headline()}
+            self.observer.add_flight_provider(self._drift_flight)
 
     # -------------------------------------------------------------- routes
     def _bucket_of(self, route, rows):
@@ -551,18 +582,26 @@ class ServingPredictor:
                pred_contrib: bool = False, pred_early_stop: bool = False,
                pred_early_stop_freq: int = 10,
                pred_early_stop_margin: float = 10.0,
-               deadline_ms=None) -> Future:
+               deadline_ms=None, ids=None) -> Future:
         """Enqueue one request; the future resolves to the same array
         ``Booster.predict`` would return for these rows.
 
         ``deadline_ms`` overrides the predictor-wide
         ``serve_request_deadline_ms`` for this request; when the queue's
         projected wait already exceeds it the future fails fast with
-        ``ServeOverloadError`` instead of queueing doomed work."""
+        ``ServeOverloadError`` instead of queueing doomed work.
+
+        ``ids``: optional per-row request ids; with drift monitoring on
+        they key this request's predictions for the delayed-label
+        channel (``record_outcome``)."""
         X = np.asarray(features, np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
         X = np.ascontiguousarray(X)
+        # the drift monitor reads the host array we already hold — bins
+        # with the frozen training mappers, no device work, no fences
+        if self.drift is not None:
+            self.drift.observe_features(X)
         route = self._route_for(raw_score, pred_contrib, pred_early_stop,
                                 pred_early_stop_freq,
                                 pred_early_stop_margin, X.shape[1])
@@ -573,12 +612,37 @@ class ServingPredictor:
             X = self.cache.normalize(X)
         deadline_s = (None if deadline_ms is None
                       else max(0.0, float(deadline_ms)) / 1e3 or None)
-        return self.scheduler.submit(route, X, X.shape[0],
-                                     deadline_s=deadline_s)
+        fut = self.scheduler.submit(route, X, X.shape[0],
+                                    deadline_s=deadline_s)
+        if self.drift is not None and not pred_contrib:
+            raw = bool(raw_score)
+
+            def _capture(f, raw=raw, ids=ids):
+                if f.cancelled() or f.exception() is not None:
+                    return
+                try:
+                    out = f.result()
+                    self.drift.observe_scores(out, raw=raw)
+                    if ids is not None:
+                        self.drift.note_predictions(ids, out, raw=raw)
+                except Exception as e:   # monitoring never fails a request
+                    Log.warning("drift: score capture failed: %s", e)
+            fut.add_done_callback(_capture)
+        return fut
 
     def predict(self, features, **kw) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
         return self.submit(features, **kw).result()
+
+    def record_outcome(self, ids, labels) -> int:
+        """The delayed-label channel: join ground-truth labels with the
+        predictions earlier ``submit(..., ids=...)`` calls recorded, so
+        the drift monitor can track rolling online AUC/logloss vs the
+        training-time reference.  Returns how many ids joined; 0 with
+        drift monitoring off."""
+        if self.drift is None:
+            return 0
+        return self.drift.record_outcome(ids, labels)
 
     def warmup(self, sizes=(), raw_score: bool = False):
         """Pre-compile the bucket executables covering ``sizes`` row
@@ -596,6 +660,8 @@ class ServingPredictor:
             out["executables"] = self.cache.stats()
         if self.slo is not None:
             out["slo"] = self.slo.summary()
+        if self.drift is not None:
+            out["drift"] = self.drift.summary()
         return out
 
     def close(self):
@@ -608,9 +674,14 @@ class ServingPredictor:
         if self._slo_flight is not None:
             self.observer.remove_flight_provider(self._slo_flight)
             self._slo_flight = None
+        if self._drift_flight is not None:
+            self.observer.remove_flight_provider(self._drift_flight)
+            self._drift_flight = None
         if self._summary_done:
             return
         self._summary_done = True
+        if self.drift is not None:
+            self.drift.close()
         st = self.stats()
         REGISTRY.gauge(
             "lgbm_serve_max_queue_depth",
@@ -629,6 +700,8 @@ class ServingPredictor:
                 rec["executables"] = st["executables"]
             if "slo" in st:
                 rec["slo"] = st["slo"]
+            if "drift" in st:
+                rec["drift"] = st["drift"]
             obs.event("serve_summary", **rec)
             obs.flush()
 

@@ -144,6 +144,13 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_port": "obs_http_port",
     "obs_http_host": "obs_http_addr",
     "obs_http_address": "obs_http_addr",
+    "obs_drift_rows": "obs_drift_every",
+    "obs_drift_freq": "obs_drift_every",
+    "obs_drift_window_rows": "obs_drift_window",
+    "obs_drift_psi_threshold": "obs_drift_psi",
+    "obs_drift_threshold": "obs_drift_psi",
+    "obs_fingerprint": "obs_drift_fingerprint",
+    "obs_drift_k": "obs_drift_topk",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -228,6 +235,9 @@ PARAMETER_SET = {
     "obs_utilization_every", "obs_roofline_peaks",
     # live telemetry plane (obs/live.py)
     "obs_http_port", "obs_http_addr",
+    # drift & online model-quality monitoring (obs/drift.py)
+    "obs_drift_every", "obs_drift_window", "obs_drift_psi",
+    "obs_drift_fingerprint", "obs_drift_topk", "obs_drift_min_labels",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -711,6 +721,34 @@ class Config:
         # endpoints expose run params and provenance, so routing them
         # off-host (e.g. 0.0.0.0 on a pod) is a deliberate choice.
         "obs_http_addr": ("str", "127.0.0.1"),
+        # drift & online model-quality monitoring (obs/drift.py):
+        # evaluate serving traffic against the training-time
+        # fingerprint every N submitted rows — per-feature + score
+        # PSI/KS, `drift` events, lgbm_drift_psi gauges, obs_health
+        # alerts.  0 = off (the default; fingerprints still persist so
+        # any later serving process can turn it on).
+        "obs_drift_every": ("int", 0),
+        # rolling-window size in rows: histograms reset once this many
+        # rows accumulated, so stale traffic cannot mask fresh drift
+        "obs_drift_window": ("int", 8192),
+        # PSI alert threshold (fires at >=, clears at half): 0.1-0.25
+        # is the conventional 'moderate shift' band — 0.2 pages on the
+        # upper half of it
+        "obs_drift_psi": ("float", 0.2),
+        # capture the per-feature binned histograms of the training
+        # sample and persist them with the model text / binned dataset
+        # dir as the serving-time drift reference.  On by default: the
+        # cost is one bincount per feature over the binning sample the
+        # data-quality profile already scans.
+        "obs_drift_fingerprint": ("bool", True),
+        # top-k most-divergent features carried in each drift event and
+        # exported as lgbm_drift_psi{feature=...} gauges (bounds the
+        # metric cardinality on wide models)
+        "obs_drift_topk": ("int", 10),
+        # minimum joined (prediction, outcome) pairs before online
+        # AUC/logloss emit as `online_quality` events
+        # (ServingPredictor.record_outcome delayed-label channel)
+        "obs_drift_min_labels": ("int", 100),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

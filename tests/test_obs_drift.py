@@ -1,0 +1,333 @@
+"""Drift & online model-quality monitoring (lightgbm_tpu/obs/drift.py).
+
+The retrain-now loop: the training-time fingerprint (per-feature binned
+histograms + score distribution + eval snapshot) must round-trip
+byte-identical through BOTH persistence paths (model text, binned
+dataset dir); the DriftMonitor must fire on a genuinely shifted stream
+while an i.i.d. holdout stays clean over many windows (no PSI
+small-sample false positives); the serving-input anomaly guard must
+count and warn exactly once per feature; delayed labels must join into
+``online_quality`` events; and the ``obs drift --check`` gate must
+exit nonzero exactly when an alert fired (or monitoring never ran).
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import RunObserver, read_events
+from lightgbm_tpu.obs.drift import (DriftMonitor, drift_metrics, ks_stat,
+                                    psi, render_drift_report,
+                                    score_histogram, _group_map)
+from lightgbm_tpu.obs.events import validate_event
+from lightgbm_tpu.obs.metrics import REGISTRY
+
+N_FEATURES = 6
+
+
+def _data(n=1500, f=N_FEATURES, seed=0, loc=0.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(loc=loc, size=(n, f))
+    w = np.linspace(1.0, -1.0, f)
+    y = (X @ w + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbose": -1, "metric": ["auc", "binary_logloss"]},
+                     ds, num_boost_round=8,
+                     valid_sets=[ds], valid_names=["train"],
+                     verbose_eval=False)
+
+
+def _canon(fp):
+    return json.dumps(fp, sort_keys=True)
+
+
+# ------------------------------------------------- fingerprint round trips
+
+def test_fingerprint_captures_features_scores_eval(booster):
+    fp = booster._gbdt.drift_fingerprint()
+    assert fp is not None and fp["version"] == 1
+    assert len(fp["features"]) == N_FEATURES
+    for f in fp["features"]:
+        assert sum(f["counts"]) > 0 and "mapper" in f
+    assert "raw" in fp["scores"] and "output" in fp["scores"]
+    metrics = {r["metric"] for r in fp["eval"]}
+    assert "auc" in metrics and "binary_logloss" in metrics
+
+
+def test_fingerprint_model_text_roundtrip(booster):
+    fp = booster._gbdt.drift_fingerprint()
+    s = booster.model_to_string()
+    assert "drift_fingerprint=" in s
+    loaded = lgb.Booster(model_str=s)
+    fp2 = loaded._gbdt.drift_fingerprint()
+    assert _canon(fp2) == _canon(fp)          # byte-identical
+    # and the re-save carries it unchanged
+    assert _canon(lgb.Booster(
+        model_str=loaded.model_to_string())._gbdt.drift_fingerprint()) \
+        == _canon(fp)
+
+
+def test_fingerprint_binned_dir_roundtrip(tmp_path):
+    from lightgbm_tpu.io.binned_format import save_training_data
+    from lightgbm_tpu.io.dataset import TrainingData
+    X, y = _data(n=600)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    td = ds._handle
+    fp = td._drift_fingerprint
+    assert fp is not None
+    out = str(tmp_path / "binned")
+    save_training_data(td, out)
+    td2 = TrainingData.from_binned(out)
+    assert _canon(td2._drift_fingerprint) == _canon(fp)
+
+
+def test_fingerprint_off_switch(tmp_path):
+    X, y = _data(n=400)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "obs_drift_fingerprint": False},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst._gbdt.drift_fingerprint() is None
+    assert "drift_fingerprint=" not in bst.model_to_string()
+
+
+# --------------------------------------------------------- divergence math
+
+def test_psi_ks_basics():
+    ref = np.array([100, 100, 100, 100])
+    assert psi(ref, ref * 3) < 0.01           # scale-invariant
+    assert psi(ref, np.array([10, 10, 10, 370])) > 1.0
+    assert ks_stat(ref, ref) < 0.01
+    assert 0.7 < ks_stat(ref, np.array([0, 0, 0, 400])) <= 1.0
+
+
+def test_group_map_equalizes_reference_mass():
+    rng = np.random.default_rng(1)
+    ref = rng.integers(1, 50, size=255)
+    gmap, n = _group_map(ref)
+    assert n <= 16 and gmap.shape == (255,)
+    masses = np.bincount(gmap, weights=ref, minlength=n)
+    assert masses.min() > 0
+    # greedy equal-mass packing: no group hoards the distribution
+    assert masses.max() / ref.sum() < 0.25
+
+
+def test_score_histogram_edges_monotone():
+    h = score_histogram(np.random.default_rng(2).normal(size=500))
+    edges = np.asarray(h["edges"])
+    assert np.all(np.diff(edges) > 0)
+    assert sum(h["counts"]) == 500
+    assert len(h["counts"]) == len(edges) + 1
+
+
+# ------------------------------------------------- monitor: drill + guard
+
+def test_shifted_stream_fires_iid_stays_clean(booster, tmp_path):
+    """The acceptance drill at unit scale: 50 i.i.d. windows with real
+    model scores must produce ZERO alerts (the PSI small-sample bias
+    over raw mapper bins would false-positive without the
+    equal-mass bin grouping); a mean-shifted stream must fire."""
+    fp = booster._gbdt.drift_fingerprint()
+    path = str(tmp_path / "drill.jsonl")
+    obs = RunObserver(events_path=path)
+    rng = np.random.default_rng(5)
+    mon = DriftMonitor(fp, observer=obs, every_rows=256,
+                       window_rows=1024, psi_threshold=0.2)
+    for _ in range(50):
+        Xh = rng.normal(size=(256, N_FEATURES))
+        mon.observe_features(Xh)
+        mon.observe_scores(booster.predict(Xh), raw=False)
+    assert mon.alerts_fired == 0, "i.i.d. false positive: %r" % (
+        mon.headline(),)
+    for _ in range(4):
+        mon.observe_features(rng.normal(loc=2.5, size=(256, N_FEATURES)))
+    assert mon.alerting and mon.alerts_fired == 1
+    mon.close()
+    obs.close()
+    evs = read_events(path)                    # schema-validates
+    drifts = [e for e in evs if e["ev"] == "drift"]
+    assert drifts and drifts[-1]["alert"] == "firing"
+    assert [e for e in evs if e["ev"] == "health"
+            and e.get("check") == "drift" and e["status"] == "warn"]
+    for e in drifts:
+        validate_event(e)
+
+
+def test_input_anomaly_guard_counts_and_warns_once(booster, tmp_path):
+    fp = booster._gbdt.drift_fingerprint()
+    path = str(tmp_path / "anom.jsonl")
+    obs = RunObserver(events_path=path)
+    mon = DriftMonitor(fp, observer=obs, every_rows=10_000)
+    X = np.zeros((8, N_FEATURES))
+    X[0, 0] = np.nan
+    X[1, 0] = np.inf
+    X[2, 1] = 1e13                            # far outside any bin range
+    mon.observe_features(X)
+    mon.observe_features(X)           # second block: counts, no new warn
+    fs = {f.name: f for f in mon._feats}
+    assert fs["Column_0"].non_finite == 4
+    assert fs["Column_1"].out_of_range == 2
+    snap = REGISTRY.snapshot()
+    assert any("lgbm_serve_input_anomalies_total" in k
+               and "Column_0" in k and "non_finite" in k
+               for k in snap), list(snap)
+    obs.close()
+    warns = [e for e in read_events(path) if e["ev"] == "health"
+             and e.get("check") == "serve_input"]
+    assert len(warns) == 2                    # once per affected feature
+    flags = {w["detail"]["flag"] for w in warns}
+    assert flags == {"non_finite", "out_of_range"}
+
+
+def test_online_quality_from_delayed_labels(booster, tmp_path):
+    fp = booster._gbdt.drift_fingerprint()
+    path = str(tmp_path / "oq.jsonl")
+    obs = RunObserver(events_path=path)
+    mon = DriftMonitor(fp, observer=obs, every_rows=256,
+                       window_rows=1024, min_labels=100)
+    Xh, yh = _data(n=512, seed=9)
+    mon.observe_features(Xh)
+    probs = booster.predict(Xh)
+    ids = list(range(512))
+    mon.note_predictions(ids, probs)
+    assert mon.record_outcome(ids, yh) == 512
+    assert mon.record_outcome([999999], [1.0]) == 0   # unknown id
+    mon.evaluate(force=True)
+    mon.close()
+    obs.close()
+    oq = [e for e in read_events(path) if e["ev"] == "online_quality"]
+    assert oq
+    rec = oq[-1]
+    validate_event(rec)
+    assert rec["n"] == 512 and rec["auc"] > 0.9
+    assert rec["ref_auc"] > 0.9 and rec["logloss"] > 0
+
+
+def test_serving_predictor_wiring(booster, tmp_path):
+    """submit() feeds the monitor, scores ride the future callback,
+    serve_summary carries the drift digest, /statusz flight section
+    appears, and record_outcome joins through the predictor."""
+    path = str(tmp_path / "serve.jsonl")
+    obs = RunObserver(events_path=path)
+    with booster.serve(observer=obs, max_batch=256, max_delay_ms=1.0,
+                       drift_every=256, drift_window=1024,
+                       drift_min_labels=64) as sp:
+        assert sp.drift is not None and sp.drift.enabled
+        rng = np.random.default_rng(13)
+        futs = []
+        for i in range(4):
+            Xb = rng.normal(loc=2.0, size=(256, N_FEATURES))
+            futs.append(sp.submit(Xb, ids=list(range(i * 256,
+                                                     (i + 1) * 256))))
+        for f in futs:
+            f.result(timeout=30)
+        import time
+        time.sleep(0.2)
+        assert sp.record_outcome(list(range(100)),
+                                 np.ones(100)) == 100
+        from lightgbm_tpu.obs.live import WatchRenderer
+        snap = obs.flight_context()
+        assert "drift" in snap, list(snap)
+        sbuf = io.StringIO()
+        WatchRenderer(out=sbuf).render_status({"flight": snap})
+        assert "drift psi" in sbuf.getvalue()
+        stats = sp.stats()
+    obs.close()
+    assert stats["drift"]["alerts_fired"] >= 1
+    evs = read_events(path)
+    summ = [e for e in evs if e["ev"] == "serve_summary"][-1]
+    assert summ["drift"]["alerts_fired"] >= 1
+    assert [e for e in evs if e["ev"] == "drift"]
+
+
+def test_booster_predict_hook(tmp_path):
+    """Booster.predict on a fingerprinted model with obs_drift_every
+    set monitors without a ServingPredictor in the loop."""
+    X, y = _data(n=800, seed=3)
+    path = str(tmp_path / "predict.jsonl")
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "obs_events_path": path,
+                     "obs_drift_every": 256, "obs_drift_window": 1024},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    rng = np.random.default_rng(4)
+    for _ in range(4):
+        bst.predict(rng.normal(loc=3.0, size=(256, N_FEATURES)))
+    mon = bst._drift_monitor
+    assert mon is not None and mon.alerts_fired >= 1
+
+
+# -------------------------------------------------------- reader & gates
+
+def _drift_timeline(tmp_path, name, shifted):
+    X, y = _data(n=800, seed=7)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    fp = bst._gbdt.drift_fingerprint()
+    path = str(tmp_path / name)
+    obs = RunObserver(events_path=path)
+    mon = DriftMonitor(fp, observer=obs, every_rows=256,
+                       window_rows=1024)
+    rng = np.random.default_rng(8)
+    for _ in range(4):
+        mon.observe_features(
+            rng.normal(loc=3.0 if shifted else 0.0,
+                       size=(256, N_FEATURES)))
+    mon.close()
+    obs.close()
+    return path
+
+
+def test_obs_drift_cli_check_exit_codes(tmp_path):
+    from lightgbm_tpu.obs.query import main as obs_main
+    hot = _drift_timeline(tmp_path, "hot.jsonl", shifted=True)
+    assert obs_main(["drift", hot, "--check"]) == 1
+    cold = _drift_timeline(tmp_path, "cold.jsonl", shifted=False)
+    assert obs_main(["drift", cold, "--check"]) in (0, None)
+    # a timeline that never monitored must NOT pass as "no drift"
+    empty = str(tmp_path / "empty.jsonl")
+    obs = RunObserver(events_path=empty)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.close()
+    assert obs_main(["drift", empty, "--check"]) == 1
+
+
+def test_drift_report_renders_diff_table(tmp_path):
+    evs = read_events(_drift_timeline(tmp_path, "r.jsonl", shifted=True))
+    m = drift_metrics(evs)
+    assert m["present"] and m["psi_max"] > 0.2
+    assert m["alerts"]["fired"] >= 1 and m["alerts"]["active"]
+    buf = io.StringIO()
+    problems = render_drift_report(evs, out=buf, check=True)
+    txt = buf.getvalue()
+    assert problems
+    assert "features by divergence" in txt and "->" in txt
+    assert "verdict: FAIL" in txt
+
+
+def test_ledger_folds_drift_cells(tmp_path):
+    from lightgbm_tpu.obs.ledger import METRIC_DIRECTIONS, \
+        metrics_from_events
+    evs = read_events(_drift_timeline(tmp_path, "l.jsonl", shifted=True))
+    m = metrics_from_events(evs)
+    assert m.get("drift_psi_max", 0) > 0.2
+    assert METRIC_DIRECTIONS["drift_psi_max"] == -1
+
+
+def test_watch_renders_drift_lines(tmp_path):
+    from lightgbm_tpu.obs.live import WatchRenderer
+    evs = read_events(_drift_timeline(tmp_path, "w.jsonl", shifted=True))
+    buf = io.StringIO()
+    r = WatchRenderer(out=buf)
+    for e in evs:
+        r.feed(e)
+    assert "DRIFT[warn]" in buf.getvalue()

@@ -265,6 +265,32 @@ NOTES = {
                      "exposing it beyond the host (0.0.0.0) is a "
                      "deliberate act, the endpoints carry params and "
                      "provenance",
+    "obs_drift_every": "serving-side drift monitoring: evaluate "
+                       "PSI/KS divergence of the submitted traffic vs "
+                       "the training-time fingerprint every N rows "
+                       "(0 = off); verdicts land as schema-14 `drift` "
+                       "events, `lgbm_drift_psi` gauges and the "
+                       "obs_health warn channel — read back with "
+                       "`obs drift`",
+    "obs_drift_window": "rolling drift window in rows; counts reset "
+                        "once the window fills so stale traffic "
+                        "cannot mask fresh drift",
+    "obs_drift_psi": "PSI alert threshold (0.2 is the classic "
+                     "'significant shift' line); alerts clear with "
+                     "hysteresis at half the threshold",
+    "obs_drift_fingerprint": "capture the per-feature binned-histogram "
+                             "+ score-distribution fingerprint at "
+                             "training time and persist it in the "
+                             "model text / binned dataset dir (the "
+                             "serving reference; ~free, reuses the "
+                             "BinMapper sample)",
+    "obs_drift_topk": "features kept per drift event / "
+                      "`lgbm_drift_psi` gauge series, ranked by "
+                      "divergence",
+    "obs_drift_min_labels": "joined (prediction, outcome) pairs "
+                            "required before an `online_quality` "
+                            "event (rolling online AUC/logloss vs the "
+                            "training-time eval reference) is emitted",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -348,7 +374,10 @@ GROUPS = [
         "obs_flight_events", "obs_split_audit", "obs_importance_every",
         "obs_importance_topk", "obs_data_profile", "obs_ledger_dir",
         "obs_ledger_suite", "obs_ledger_window", "obs_utilization_every",
-        "obs_roofline_peaks", "obs_http_port", "obs_http_addr"]),
+        "obs_roofline_peaks", "obs_http_port", "obs_http_addr",
+        "obs_drift_every", "obs_drift_window", "obs_drift_psi",
+        "obs_drift_fingerprint", "obs_drift_topk",
+        "obs_drift_min_labels"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",
