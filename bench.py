@@ -770,6 +770,172 @@ def dry():
                       "path": obs_path}))
 
 
+def incident_drill():
+    """Tier-1-safe incident-engine drill (CI: JAX_PLATFORMS=cpu
+    python bench.py --dry --incident): two tiny training runs with the
+    incident engine armed (obs/incident.py).  The FAULT run injects a
+    repeating non-finite-gradient health warning plus a straggler-skew
+    warning inside one debounce window and must open exactly ONE
+    grouped incident whose evidence bundle lands on disk with the ring
+    slice, metrics snapshot and statusz snapshot.  The CONTROL run is
+    identical minus the injection and must open ZERO incidents — that
+    asymmetry is what `obs incident --check` gates on in CI.  Capture
+    is host-side only: the fence counter must be flat across the
+    injected trigger and the evidence capture it kicks off."""
+    from lightgbm_tpu.utils.common import honor_jax_platforms
+    honor_jax_platforms()
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import read_events
+    from lightgbm_tpu.obs import timers as obs_timers
+    from lightgbm_tpu.obs.ledger import default_ledger_dir
+    import io as _io
+    import shutil
+    import urllib.request
+
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(1500, 8)).astype(np.float32)
+    w = rng.normal(size=8)
+    y = (X @ w > 0).astype(np.float64)
+
+    fault_path = "/tmp/incident_fault.jsonl"
+    control_path = "/tmp/incident_fault.jsonl.control"
+    bundle_dir = "/tmp/incident_bundles"
+    for p in (fault_path, control_path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    shutil.rmtree(bundle_dir, ignore_errors=True)
+
+    def run_one(obs_path, suite, inject):
+        params = {"objective": "binary", "num_leaves": 15, "max_bin": 15,
+                  "verbose": -1, "obs_events_path": obs_path,
+                  "obs_health": "warn", "obs_metrics_every": 2,
+                  "obs_incident": True,
+                  # one window swallows everything this short run emits:
+                  # both injected signals MUST group into one incident
+                  "obs_incident_window_s": 30.0,
+                  "obs_incident_dir": bundle_dir,
+                  "obs_ledger_dir": default_ledger_dir(),
+                  "obs_ledger_suite": suite,
+                  "obs_http_port": 0}
+        poked = {}
+
+        def _fault(env):
+            if not inject:
+                return
+            it = env.iteration - env.begin_iteration
+            obs = env.model._gbdt._obs
+            if it == 2 and "inject" not in poked:
+                poked["inject"] = True
+                fences0 = obs_timers.fence_count()
+                # the guard fires every iteration while gradients are
+                # non-finite — health dedup must collapse the repeats
+                # into ONE warn event (and so one incident signal)
+                for _ in range(3):
+                    obs.health._resolve(obs, it, [
+                        ("nonfinite_gradients",
+                         {"grad_abs_mean": "nan", "injected": True})])
+                obs.event("health", check="straggler_skew",
+                          status="warn", it=it,
+                          detail={"skew": 0.9, "slowest": 0,
+                                  "injected": True})
+                assert obs_timers.fence_count() == fences0, \
+                    "incident trigger + evidence capture issued a " \
+                    "host sync — capture must be host-side only"
+            if it == 3 and "poke" not in poked:
+                poked["poke"] = True
+                url = obs.live_url
+                req = urllib.request.Request(
+                    url + "/trigger/flight", data=b"", method="POST")
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    assert r.status == 200, \
+                        "POST /trigger/flight: %d" % r.status
+                with urllib.request.urlopen(url + "/incidents",
+                                            timeout=5) as r:
+                    listing = json.loads(r.read().decode())
+                    assert r.status == 200 and listing["enabled"], \
+                        "/incidents listing: %r" % listing
+                    assert listing["open"] or listing["closed"], \
+                        "/incidents empty after an injected trigger"
+
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6,
+                  callbacks=[_fault])
+        if inject:
+            assert poked.get("inject") and poked.get("poke"), \
+                "fault callback never fired: %r" % poked
+        return read_events(obs_path)
+
+    evs = run_one(fault_path, "bench_incident_fault", inject=True)
+    evs_ctl = run_one(control_path, "bench_incident_control",
+                      inject=False)
+
+    # --- fault run: exactly one grouped incident, evidence on disk ---
+    opens = [e for e in evs if e["ev"] == "incident_open"]
+    closes = [e for e in evs if e["ev"] == "incident_close"]
+    assert len(opens) == 1, \
+        "fault drill must open exactly ONE grouped incident, got %d" \
+        % len(opens)
+    assert len(closes) == 1, "incident never closed: %r" % closes
+    signals = closes[0]["signals"]
+    for need in ("nonfinite_gradients", "straggler_skew"):
+        assert need in signals, \
+            "incident did not group %r: signals=%r" % (need, signals)
+    arts = [e["artifact"] for e in evs if e["ev"] == "incident_evidence"
+            and not e.get("error")]
+    for need in ("ring", "metrics", "statusz"):
+        assert need in arts, \
+            "evidence bundle missing %r artifact: %r" % (need, arts)
+    assert len(arts) >= 3, "fewer than 3 evidence artifacts: %r" % arts
+    inc_dir = closes[0].get("dir")
+    assert inc_dir and os.path.isdir(inc_dir), \
+        "incident bundle dir missing on disk: %r" % inc_dir
+    for fname in ("incident.json", "ring.jsonl"):
+        assert os.path.isfile(os.path.join(inc_dir, fname)), \
+            "bundle %s missing %s" % (inc_dir, fname)
+    # health dedup (edge-triggered warn channel): three guard firings
+    # above must have produced exactly one nonfinite warn event
+    nf = [e for e in evs if e["ev"] == "health"
+          and e.get("check") == "nonfinite_gradients"]
+    assert len(nf) == 1, \
+        "health dedup failed: %d nonfinite_gradients events" % len(nf)
+    end = [e for e in evs if e["ev"] == "run_end"][-1]
+    dig = end.get("incidents")
+    assert dig and dig.get("opened") == 1 and \
+        dig.get("max_signals", 0) >= 2, \
+        "run_end incidents digest wrong: %r" % dig
+
+    # --- control run: zero incidents, digest records the zeros ---
+    assert not [e for e in evs_ctl if e["ev"].startswith("incident_")], \
+        "clean control run emitted incident events"
+    end_ctl = [e for e in evs_ctl if e["ev"] == "run_end"][-1]
+    dig_ctl = end_ctl.get("incidents")
+    assert dig_ctl is not None and dig_ctl.get("opened") == 0, \
+        "control run_end incidents digest wrong: %r" % dig_ctl
+
+    # --- the reader gates exactly the way CI will use it ---
+    from lightgbm_tpu.obs import query as obs_query
+    assert obs_query.main(["incident", fault_path, "--check"]) == 1, \
+        "obs incident --check must exit 1 on the fault timeline"
+    assert obs_query.main(["incident", inc_dir, "--check"]) == 1, \
+        "obs incident --check must exit 1 on the bundle dir"
+    assert obs_query.main(["incident", control_path, "--check"]) == 0, \
+        "obs incident --check must exit 0 on the control timeline"
+    from lightgbm_tpu.obs.live import watch as obs_watch
+    watch_out = _io.StringIO()
+    assert obs_watch(fault_path, once=True, out=watch_out) == 0
+    assert "INCIDENT OPEN" in watch_out.getvalue(), \
+        "obs watch rendered no INCIDENT line:\n%s" % watch_out.getvalue()
+
+    print(json.dumps({"status": "incident_ok",
+                      "opened": len(opens),
+                      "signals": sorted(signals),
+                      "artifacts": sorted(arts),
+                      "bundle": inc_dir,
+                      "fault_path": fault_path,
+                      "control_path": control_path}))
+
+
 def mp_bench(world):
     """Multi-host weak-scaling measurement (--mp N): a 1-rank baseline
     and an N-rank run of the SAME per-rank shape through the subprocess
@@ -932,7 +1098,10 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--prepare-cache":
         prepare_cache()
     elif len(sys.argv) > 1 and sys.argv[1] == "--dry":
-        dry()
+        if "--incident" in sys.argv[2:]:
+            incident_drill()
+        else:
+            dry()
     elif len(sys.argv) > 1 and sys.argv[1] == "--construct":
         construct_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "--mp":

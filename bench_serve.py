@@ -200,7 +200,13 @@ def main(argv=None):
                       ledger_dir=ledger_dir,
                       ledger_suite="serve_overload" if args.overload
                       else "serve",
-                      http_port=(0 if args.dry else None))
+                      http_port=(0 if args.dry else None),
+                      # incident engine armed on the gated drills: the
+                      # overload shed storm must OPEN one, the clean dry
+                      # run must open ZERO (asserted below)
+                      incident=(args.dry or args.overload),
+                      incident_window_s=10.0,
+                      incident_dir=obs_path + ".incidents")
     obs.run_header(backend=jax.default_backend(),
                    devices=[str(d) for d in jax.local_devices()],
                    params={"requests": requests, "threads": args.threads,
@@ -436,6 +442,9 @@ def _dry_asserts(bst, X, obs_path, steady_state_compiles, stats):
         assert need in kinds, "serve timeline missing %r events" % need
     assert stats.get("shed_total", 0) == 0, \
         "non-overload dry run shed requests: %r" % stats.get("shed")
+    assert not [e for e in evs if e["ev"].startswith("incident_")], \
+        "clean serve dry run opened an incident — the control side of " \
+        "the CI incident gate must stay silent"
     reqs = [e for e in evs if e["ev"] == "serve_request"]
     assert all("queue_s" in e.get("spans", {}) for e in reqs), \
         "serve_request trace missing queue_s span"
@@ -488,10 +497,27 @@ def _overload_asserts(obs_path, offered, shed, p99_admitted,
     assert alerts, "no slo_burn_rate health warning under overload"
     summ = [e for e in evs if e["ev"] == "serve_summary"][-1]
     assert summ["shed_total"] == shed
+    # incident engine (obs/incident.py): the shed storm fires
+    # incident_signal from the scheduler, and the burn-rate warning
+    # joins the same debounce window — ONE grouped incident, with its
+    # evidence bundle captured entirely host-side
+    opens = [e for e in evs if e["ev"] == "incident_open"]
+    closes = [e for e in evs if e["ev"] == "incident_close"]
+    assert len(opens) == 1, \
+        "overload must open exactly ONE grouped incident, got %d" \
+        % len(opens)
+    assert closes and "shed_storm" in closes[0]["signals"], \
+        "shed storm never reached the incident: %r" % closes
+    arts = [e["artifact"] for e in evs if e["ev"] == "incident_evidence"
+            and not e.get("error")]
+    assert len(arts) >= 3, \
+        "overload incident bundle thin (%r) — want ring, metrics, " \
+        "statusz at least" % arts
     print(json.dumps({
         "status": "serve_overload_ok", "offered": offered,
         "shed": shed, "shed_rate": round(shed / float(offered), 4),
         "p99_admitted_ms": round(p99_admitted * 1e3, 2),
+        "incident_signals": sorted(closes[0]["signals"]),
         "burn_alerts": len(alerts)}), file=sys.stderr)
 
 

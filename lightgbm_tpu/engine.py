@@ -153,10 +153,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 if total_rounds % ck_every == 0 and \
                         int(getattr(comm, "rank", 0) or 0) == 0:
                     from .models import checkpoint as ckpt_mod
+                    obs = booster._gbdt._obs
+                    obs.stamp_context(stage="checkpoint", it=total_rounds)
                     path = ckpt_mod.save_checkpoint(
                         ck_dir, booster._gbdt, total_rounds, params,
                         world_size=world)
-                    obs = booster._gbdt._obs
                     if obs.enabled:
                         import os as _os
                         obs.event("checkpoint", it=total_rounds,
@@ -166,6 +167,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
             evaluation_result_list = []
             if valid_sets is not None or feval is not None:
+                # context stamp for incident bundles: an anomaly firing
+                # here happened during eval, not mid-boost
+                booster._gbdt._obs.stamp_context(stage="eval", it=i)
                 if is_valid_contain_train:
                     evaluation_result_list.extend(booster.eval_train(feval))
                 evaluation_result_list.extend(booster.eval_valid(feval))

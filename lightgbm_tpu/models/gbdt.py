@@ -537,6 +537,10 @@ class GBDT:
         obs = self._obs
         it0 = self.iter
         obs.iter_begin(it0)
+        # iteration-context stamp: what the loop is doing right now, for
+        # /statusz and incident evidence bundles (obs/incident.py) —
+        # a host dict update, nothing on the device path
+        obs.stamp_context(stage="boost", it=it0, trees=len(self.models))
         # host-orchestration accounting (obs/timers.py): everything this
         # method does OUTSIDE the enter()/exit()-bracketed device
         # dispatches is per-iteration host glue — emitted as the

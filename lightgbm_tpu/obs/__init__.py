@@ -62,9 +62,19 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   out-of-range input anomalies, and joins delayed labels
   (``ServingPredictor.record_outcome``) into rolling online
   AUC/logloss vs the training reference (``online_quality`` events);
+* ``incident`` — the incident engine (``obs_incident*``): taps every
+  detector channel (health warn/fatal, SLO burn, straggler skew,
+  watchdog near-expiry, steady-state recompiles, drift alerts, serve
+  shed storms, operator POSTs), debounces co-occurring signals into one
+  grouped incident (schema-15 ``incident_open`` / ``incident_evidence``
+  / ``incident_close``), captures an evidence bundle at the moment of
+  anomaly (ring slice, metrics snapshot, flight context, utilization
+  rollup, /statusz snapshot, thread stacks, optional one-iteration
+  armed profiler trace), and renders the ``obs incident`` triage
+  report with cross-subsystem correlation and root-cause ranking;
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
   obs summary|recompiles|stragglers|explain|roofline|serve|drift|
-  merge|diff|trace|watch``;
+  incident|merge|diff|trace|watch``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -96,7 +106,8 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_utilization_every``, ``obs_roofline_peaks``, ``obs_http_port``,
 ``obs_http_addr``, ``obs_drift_every``, ``obs_drift_window``,
 ``obs_drift_psi``, ``obs_drift_fingerprint``, ``obs_drift_topk``,
-``obs_drift_min_labels``.
+``obs_drift_min_labels``, ``obs_incident``, ``obs_incident_window_s``,
+``obs_incident_dir``, ``obs_incident_trace``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -143,8 +154,8 @@ def observer_from_config(config, comm=None):
     / ``obs_health`` (non-off) / ``obs_metrics_path`` /
     ``obs_metrics_every`` / ``obs_compile`` / ``obs_straggler_every`` /
     ``obs_split_audit`` / ``obs_importance_every`` / ``obs_ledger_dir`` /
-    ``obs_utilization_every`` / ``obs_drift_every`` enables the
-    observer; health, metrics, compile and model tracking
+    ``obs_utilization_every`` / ``obs_drift_every`` / ``obs_incident``
+    enables the observer; health, metrics, compile and model tracking
     work without an events path (in-memory timeline via
     Booster.telemetry()).  A non-empty ``obs_ledger_dir`` additionally
     ingests the finished run into the cross-run ledger on clean close.
@@ -167,6 +178,7 @@ def observer_from_config(config, comm=None):
     utilization_every = int(getattr(config, "obs_utilization_every", 0)
                             or 0)
     drift_every = int(getattr(config, "obs_drift_every", 0) or 0)
+    incident = bool(getattr(config, "obs_incident", False))
     # -1 = off; 0 is a real value (ephemeral port), so no `or` collapse
     http_port = getattr(config, "obs_http_port", -1)
     http_port = -1 if http_port is None else int(http_port)
@@ -176,7 +188,7 @@ def observer_from_config(config, comm=None):
             and straggler_every <= 0 and not split_audit
             and importance_every <= 0 and not ledger_dir
             and utilization_every <= 0 and http_port < 0
-            and drift_every <= 0):
+            and drift_every <= 0 and not incident):
         return NULL_OBSERVER
     timing = str(getattr(config, "obs_timing", "auto")).strip().lower()
     if timing not in _TIMING_MODES:
@@ -235,4 +247,12 @@ def observer_from_config(config, comm=None):
                        http_port=(http_port if http_port >= 0 else None),
                        http_addr=str(
                            getattr(config, "obs_http_addr", "127.0.0.1")
-                           or "127.0.0.1"))
+                           or "127.0.0.1"),
+                       incident=incident,
+                       incident_window_s=float(
+                           getattr(config, "obs_incident_window_s", 5.0)
+                           or 5.0),
+                       incident_dir=str(
+                           getattr(config, "obs_incident_dir", "") or ""),
+                       incident_trace=bool(
+                           getattr(config, "obs_incident_trace", False)))

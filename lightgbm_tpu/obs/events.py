@@ -66,6 +66,16 @@ utilization    it, entries (schema 13; obs/roofline.py — per-iteration
                roofline rollup: exec-weighted flop_util / hbm_util
                against the device-peak registry, dominant bound, total
                headroom seconds; the ledger cells bench_compare gates)
+incident_open  id, trigger, signals (schema 15; obs/incident.py — the
+               anomaly-correlation engine grouped co-occurring detector
+               signals into one incident and captured its evidence
+               bundle at the moment of anomaly)
+incident_evidence id, artifact (schema 15; one captured bundle artifact
+               — ring slice, metrics snapshot, statusz snapshot, flight
+               context, utilization rollup, thread stacks, trace dir)
+incident_close id, duration_s, signals (schema 15; the quiet-window
+               close with per-kind counts in first-occurrence order —
+               the correlation table `obs incident` renders)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -104,7 +114,7 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
@@ -116,13 +126,16 @@ SCHEMA_VERSION = 14
 # and the sharded-ingest dataset_construct fields), 12 (no roofline
 # attribution — schema 13 adds the per-iteration ``utilization``
 # rollup and the ``autotune_probe.roofline`` cell stamp, obs/
-# roofline.py) and 13 (no drift monitoring — schema 14 adds the
+# roofline.py), 13 (no drift monitoring — schema 14 adds the
 # ``drift`` / ``online_quality`` serving-side distribution-shift
-# events and the serve_summary ``drift`` digest, obs/drift.py)
+# events and the serve_summary ``drift`` digest, obs/drift.py) and
+# 14 (no incident engine — schema 15 adds the ``incident_open`` /
+# ``incident_evidence`` / ``incident_close`` anomaly-correlation
+# events and the run_end ``incidents`` digest, obs/incident.py)
 # timelines still parse.  wave_band_escape stays accepted for old
 # timelines even though nothing emits it anymore (the band prior died
 # in PR-11; ops/pallas_wave.py tile planner post-mortem).
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -196,6 +209,14 @@ _REQUIRED = {
     # ServingPredictor.record_outcome
     "drift": ("rows", "window_rows", "psi_max"),
     "online_quality": ("n", "logloss"),
+    # schema 15 (obs/incident.py): anomaly correlation — one
+    # incident_open when the first qualifying detector signal arrives
+    # (with the evidence bundle captured at that moment), one
+    # incident_evidence per captured artifact, one incident_close after
+    # a quiet window with the grouped per-kind signal rollup
+    "incident_open": ("id", "trigger", "signals"),
+    "incident_evidence": ("id", "artifact"),
+    "incident_close": ("id", "duration_s", "signals"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -278,9 +299,21 @@ _OPTIONAL = {
     "drift": ("score_psi", "features", "score", "anomalies",
               "threshold", "alert"),
     "online_quality": ("auc", "pending", "ref_auc", "ref_logloss"),
+    # schema 15: the open event carries the trigger's detail and the
+    # ring seq it anchors to; the close carries the full correlation
+    # rollup (per-kind counts + first/last occurrence) and the bundle
+    # inventory
+    "incident_open": ("it", "seq", "dir", "detail"),
+    "incident_evidence": ("path", "bytes", "error", "it"),
+    "incident_close": ("counts", "artifacts", "signal_detail", "dir",
+                       "it", "window_s"),
     "run_end": ("status", "health", "compile_attr", "stragglers",
                 # obs/merge.py merged-timeline summary
-                "rank_report"),
+                "rank_report",
+                # schema 15: incident digest ({opened, max_signals}) —
+                # present whenever the engine ran, zeros included, so
+                # the ledger records a real zero history
+                "incidents"),
 }
 
 # fields event()/emit() stamp on every record regardless of type
@@ -641,6 +674,15 @@ class NullObserver:
     def remove_flight_provider(self, fn):
         pass
 
+    def incident_signal(self, kind, detail=None):
+        return None
+
+    def incidents(self):
+        return {"enabled": False, "open": [], "closed": []}
+
+    def stamp_context(self, **fields):
+        pass
+
     def iter_begin(self, it):
         pass
 
@@ -698,7 +740,9 @@ class RunObserver(NullObserver):
                  coordinator="", fsync=False, watchdog_secs=0.0,
                  flight_events=256, ledger_dir="", ledger_suite="",
                  utilization_every=0, roofline_peaks="",
-                 http_port=None, http_addr="127.0.0.1"):
+                 http_port=None, http_addr="127.0.0.1",
+                 incident=False, incident_window_s=5.0,
+                 incident_dir="", incident_trace=False):
         from . import metrics as metrics_mod
         if rank is None or world_size is None:
             info = _default_rank_info()
@@ -770,6 +814,17 @@ class RunObserver(NullObserver):
         self._ewma_iter_s = None
         self._last_utilization = None
         self._health_fatal = False
+        # host-side run context stamped by the training loop
+        # (stamp_context): what the run was doing, for /statusz and the
+        # incident evidence bundle
+        self._run_context = {}
+        self._incident = None
+        if incident:
+            from .incident import IncidentEngine
+            self._incident = IncidentEngine(
+                self, window_s=float(incident_window_s or 5.0),
+                bundle_dir=str(incident_dir or ""),
+                trace=bool(incident_trace))
         self._live = None
         if http_port is not None and int(http_port) >= 0:
             self.ensure_live_server(int(http_port), http_addr)
@@ -817,6 +872,11 @@ class RunObserver(NullObserver):
         self._ring.append(rec)
         if self._writer is not None:
             self._writer.emit(rec)
+        # incident tap LAST, after the record landed: a signal that
+        # opens an incident emits its own events re-entrantly and they
+        # must sort after their trigger in the timeline
+        if self._incident is not None:
+            self._incident.observe(rec)
         return rec
 
     def run_header(self, backend, devices, params, context):
@@ -833,6 +893,8 @@ class RunObserver(NullObserver):
         if self._watchdog is not None:
             self._watchdog.arm("iter %d" % it)
         self._trace.maybe_start(it, self)
+        if self._incident is not None:
+            self._incident.maybe_trace_start(it, self)
         self._clock.begin()
 
     def lap(self, name, value=None):
@@ -873,6 +935,8 @@ class RunObserver(NullObserver):
         if self._utilization_every and it % self._utilization_every == 0:
             self._emit_utilization(it)
         self._trace.maybe_stop(it, self)
+        if self._incident is not None:
+            self._incident.maybe_trace_stop(it, self)
 
     def _emit_utilization(self, it):
         """The schema-13 roofline rollup (obs/roofline.py): exec-weighted
@@ -983,6 +1047,29 @@ class RunObserver(NullObserver):
     def ring_snapshot(self):
         return self._ring.snapshot()
 
+    # -- incident engine (obs/incident.py) -----------------------------
+    def incident_signal(self, kind, detail=None):
+        """Feed one anomaly signal into the incident engine from a
+        channel that does not emit timeline events itself (the serve
+        scheduler's shed storm, the watchdog's near-expiry warning, the
+        POST /trigger/incident operator endpoint).  Returns the open
+        incident id, or None when the engine is off."""
+        if self._incident is None:
+            return None
+        return self._incident.signal(str(kind), detail=detail)
+
+    def incidents(self):
+        """Open/closed incident listing for the /incidents endpoint."""
+        if self._incident is None:
+            return {"enabled": False, "open": [], "closed": []}
+        return self._incident.listing()
+
+    def stamp_context(self, **fields):
+        """Update the host-side run-context dict (iteration, tree count,
+        loop stage) that /statusz and incident evidence bundles read —
+        a plain dict update, never a fence."""
+        self._run_context.update(fields)
+
     # -- misc ----------------------------------------------------------
     def memory_snapshot(self, it):
         self.event("memory", it=it, devices=device_memory_stats())
@@ -1013,12 +1100,22 @@ class RunObserver(NullObserver):
         except Exception:
             pass
         self._trace.force_stop(self)
+        # close any open incident BEFORE run_end so incident_close sorts
+        # inside the run; the digest rides on run_end (zeros included)
+        incidents_digest = None
+        if self._incident is not None:
+            try:
+                incidents_digest = self._incident.finalize()
+            except Exception:
+                incidents_digest = None
         metrics_on = self._metrics_every or self._metrics_path
         if metrics_on:
             self.event("metrics", it=self._iters,
                        scrape=self._registry.snapshot())
         end = {"iters": self._iters, "phase_totals": self._clock.totals(),
                "entries": self._entries.summary(), "status": status}
+        if incidents_digest is not None:
+            end["incidents"] = incidents_digest
         if self.health is not None:
             end["health"] = self.health.summary()
         if self._compile is not None:

@@ -25,6 +25,12 @@ events into the run timeline (events.py) the moment something is off:
 ``obs_health`` picks the consequence: ``off`` (no monitors), ``warn``
 (log + ``health`` event), ``fatal`` (log + event + flush the timeline +
 raise LightGBMError, aborting the run).  Cadence via ``obs_health_every``.
+
+Warn-channel events are edge-triggered: a check that keeps failing
+emits ONE ``health`` event at first occurrence and stays silent until
+it recovers (a clean evaluation re-arms it) or escalates to fatal — so
+the incident engine (obs/incident.py) groups a flapping guard into one
+incident instead of being flooded by identical recurrences.
 """
 from __future__ import annotations
 
@@ -75,6 +81,7 @@ class HealthMonitors:
         self._flat = 0
         self.mem_peak_frac = {}        # device id -> peak in_use/limit
         self.counts = {"ok": 0, "warn": 0, "fatal": 0}
+        self._firing = {}              # check -> status last emitted
 
     # ----------------------------------------------------------- staging
     def due(self, it):
@@ -131,7 +138,13 @@ class HealthMonitors:
         obs.event("health", check="stats", status=status, it=it,
                   detail=stats)
         self.counts["ok" if not problems else self.mode] += 1
-        self._resolve(obs, it, problems)
+        evaluated = set()
+        if g_mean is not None:
+            evaluated.update(("nonfinite_gradients", "loss_divergence",
+                              "plateau"))
+        if has_leaves:
+            evaluated.add("nonfinite_leaf_values")
+        self._resolve(obs, it, problems, evaluated=evaluated)
 
     def _trend(self, g_mean):
         """EMA divergence / plateau over the gradient-magnitude series."""
@@ -191,20 +204,33 @@ class HealthMonitors:
                                   "threshold": self.mem_frac}))
         if problems:
             self.counts[self.mode] += 1
-        self._resolve(obs, it, problems)
+        self._resolve(obs, it, problems, evaluated=("memory_watermark",))
 
     # ------------------------------------------------------------ actions
-    def _resolve(self, obs, it, problems):
+    def _resolve(self, obs, it, problems, evaluated=()):
+        """Emit one ``health`` event per firing check — edge-triggered
+        on the warn channel: a check already firing at ``warn`` stays
+        silent until a clean evaluation (``evaluated`` names the checks
+        this call assessed) re-arms it or it escalates to fatal.  Fatal
+        verdicts are never deduplicated: they abort the run."""
         fatal = []
+        firing = set()
         for check, detail in problems:
             status = ("warn" if (self.mode == "warn"
                                  or check in _WARN_ONLY) else "fatal")
+            firing.add(check)
+            if status == "warn" and self._firing.get(check) == "warn":
+                continue
+            self._firing[check] = status
             obs.event("health", check=check, status=status, it=it,
                       detail=detail)
             Log.warning("health[%s] %s at iteration %d: %s",
                         status, check, it, detail)
             if status == "fatal":
                 fatal.append(check)
+        for check in evaluated:
+            if check not in firing:
+                self._firing.pop(check, None)
         if fatal:
             obs.flush()           # the timeline must survive the raise
             try:                  # black box for the abort (obs/watchdog.py)

@@ -86,6 +86,12 @@ METRIC_DIRECTIONS = {
     "drift_psi_max": -1,
     "online_auc": +1,
     "online_logloss": -1,
+    # schema 15 incident engine (obs/incident.py): a commit that starts
+    # tripping incidents — or whose incidents correlate MORE signals —
+    # is a change-point the existing attribution machinery blames on
+    # the git rev that introduced it
+    "incidents_opened": -1,
+    "incident_max_signals": -1,
 }
 
 # noise floors under the MAD estimate: a flat history has MAD 0, and a
@@ -194,6 +200,22 @@ def metrics_from_events(events):
             out["online_auc"] = float(quality[-1]["auc"])
         if quality[-1].get("logloss") is not None:
             out["online_logloss"] = float(quality[-1]["logloss"])
+    # schema 15: prefer the run_end digest — it is present (zeros
+    # included) whenever the engine ran, giving incident-free runs a
+    # real zero history to change-point against; fall back to counting
+    # the events for timelines that aborted before run_end
+    inc = (run_end or {}).get("incidents")
+    if inc is not None:
+        out["incidents_opened"] = int(inc.get("opened", 0) or 0)
+        out["incident_max_signals"] = int(inc.get("max_signals", 0) or 0)
+    else:
+        opens = [e for e in events if e.get("ev") == "incident_open"]
+        if opens:
+            out["incidents_opened"] = len(opens)
+            closes = [e for e in events if e.get("ev") == "incident_close"]
+            if closes:
+                out["incident_max_signals"] = max(
+                    len(e.get("signals") or ()) for e in closes)
     return out
 
 

@@ -19,14 +19,27 @@ Every other obs surface is post-hoc — a JSONL timeline analyzed after
   right now";
 * ``/events?after=N`` — JSONL tail of the watchdog ring buffer with a
   monotonic cursor (``X-Obs-Next-After`` response header), the feed
-  behind ``obs watch <url>``.
+  behind ``obs watch <url>``;
+* ``/incidents`` — open/closed incident listing from the incident
+  engine (obs/incident.py), including each incident's grouped signals
+  and evidence inventory.
+
+Schema 15 adds operator CONTROL alongside the reads: ``POST
+/trigger/flight`` dumps a flight record on demand and ``POST
+/trigger/incident`` opens (or joins) an incident with an ``operator``
+signal — on-demand evidence capture while the anomaly is still live.
+Both are accepted **only from a loopback peer address**, whatever the
+bind address: scraping may be fleet-wide, capture control is local by
+construction.
 
 The server thread only READS host-side state the observer already
 maintains — no jax import anywhere in this module, no device access, no
 fence: scraping a live run costs the hot path nothing (the module is
-inside the graftlint hostsync scope to keep it that way).  Binding
-defaults to loopback (``obs_http_addr=127.0.0.1``); exposing the plane
-on a pod means choosing a routable bind address deliberately.
+inside the graftlint hostsync scope to keep it that way).  The POST
+handlers write evidence from the handler thread, never touching the
+hot path.  Binding defaults to loopback
+(``obs_http_addr=127.0.0.1``); exposing the plane on a pod means
+choosing a routable bind address deliberately.
 
 The second half is ``watch`` — the ``python -m lightgbm_tpu obs watch``
 live-follow renderer.  It tails a growing timeline file (parsing only
@@ -116,13 +129,19 @@ def status_snapshot(obs):
             for k in ("it", "flop_util", "hbm_util", "bound",
                       "headroom_s", "device_kind")
             if util.get(k) is not None}
+    ctx_stamp = getattr(obs, "_run_context", None)
+    if ctx_stamp:
+        # the training loop's stamp_context: iteration, tree count,
+        # loop stage — what the run was doing at this instant
+        out["context"] = dict(ctx_stamp)
     try:
         ctx = obs.flight_context()
     except Exception:
         ctx = {}
     if ctx:
-        # serve queue depth + SLO headline land here via the
-        # flight-provider registry (serve/scheduler.py)
+        # serve queue depth, SLO headline and the incident engine's
+        # open/opened counters land here via the flight-provider
+        # registry (serve/scheduler.py, obs/incident.py)
         out["flight"] = ctx
     ring = getattr(obs, "_ring", None)
     if ring is not None:
@@ -195,15 +214,70 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                for r in recs)
                 self._send(200, "application/x-ndjson", body,
                            headers=(("X-Obs-Next-After", str(seq)),))
+            elif route == "/incidents":
+                self._send_json(200, obs.incidents())
             elif route == "/":
                 self._send_json(200, {"endpoints": ["/metrics", "/healthz",
-                                                    "/statusz", "/events"],
+                                                    "/statusz", "/events",
+                                                    "/incidents",
+                                                    "POST /trigger/flight",
+                                                    "POST /trigger/incident"],
                                       "run": getattr(obs, "run_id", None)})
             else:
                 self._send_json(404, {"error": "unknown path %s"
                                       % parsed.path})
         except Exception as e:      # a broken scrape must not kill serving
             try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def _loopback_peer(self):
+        peer = self.client_address[0] if self.client_address else ""
+        return peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+
+    def do_POST(self):
+        """Operator control: on-demand flight dump and incident open.
+        Loopback peers only — a routable bind address exposes the READ
+        plane fleet-wide, never capture control."""
+        obs = self.server.observer
+        try:
+            # drain the body first (HTTP/1.1 keep-alive contract),
+            # whatever the verdict
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            raw = self.rfile.read(length) if length > 0 else b""
+            if not self._loopback_peer():
+                self._send_json(403, {"error": "control endpoints accept "
+                                               "loopback POSTs only"})
+                return
+            try:
+                body = json.loads(raw.decode("utf-8", "replace")) or {}
+            except ValueError:
+                body = {}
+            if not isinstance(body, dict):
+                body = {}
+            reason = str(body.get("reason") or "operator request")[:200]
+            route = urllib.parse.urlsplit(self.path).path.rstrip("/")
+            if route == "/trigger/flight":
+                path = obs.flight("operator: %s" % reason)
+                self._send_json(200, {"triggered": "flight",
+                                      "path": path or None})
+            elif route == "/trigger/incident":
+                iid = obs.incident_signal("operator", {"reason": reason})
+                if iid is None:
+                    self._send_json(409, {"error": "incident engine off "
+                                                   "(obs_incident=false)"})
+                else:
+                    self._send_json(200, {"triggered": "incident",
+                                          "id": iid})
+            else:
+                self._send_json(404, {"error": "unknown control path %s"
+                                      % self.path})
+        except Exception as e:      # a broken control call must not kill
+            try:                    # the run it observes
                 self._send_json(500, {"error": repr(e)})
             except Exception:
                 pass
@@ -452,6 +526,22 @@ class WatchRenderer:
             if rec.get("logloss") is not None:
                 bits.append("logloss %.4f" % float(rec["logloss"]))
             self._w(tag + "online: " + "  ".join(bits))
+        elif ev == "incident_open":
+            sigs = ", ".join(str(s) for s in rec.get("signals") or ())
+            self._w("%sINCIDENT OPEN [%s] trigger %s%s"
+                    % (tag, rec.get("id"), rec.get("trigger"),
+                       ("  -> %s" % rec["dir"]) if rec.get("dir") else ""))
+            if sigs:
+                self._w("%s  signals: %s" % (tag, sigs))
+        elif ev == "incident_close":
+            sigs = list(rec.get("signals") or ())
+            counts = rec.get("counts") or {}
+            total = sum(int(v or 0) for v in counts.values()) or len(sigs)
+            self._w("%sINCIDENT CLOSE [%s] %d signal kind(s), %d event(s)"
+                    " over %.1fs: %s"
+                    % (tag, rec.get("id"), len(sigs), total,
+                       float(rec.get("duration_s", 0.0) or 0.0),
+                       ", ".join(str(s) for s in sigs)))
         elif ev == "serve_summary":
             shed = int(rec.get("shed_total", 0))
             self._w("%sserve: %s batches  %s rows  shed %d%s"
@@ -513,6 +603,14 @@ class WatchRenderer:
                 bits.append("drift psi %.3f" % float(last["psi_max"]))
             if drift.get("alerting"):
                 bits.append("DRIFT ALERT")
+        inc = (status.get("flight") or {}).get("incidents")
+        if inc:
+            if inc.get("open"):
+                last = inc.get("last") or {}
+                bits.append("INCIDENT OPEN (%s)"
+                            % (last.get("trigger") or "?"))
+            elif inc.get("opened"):
+                bits.append("incidents %s" % inc.get("opened"))
         self._w("status: " + "  ".join(bits))
 
 

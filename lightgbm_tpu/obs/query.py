@@ -36,6 +36,13 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   divergence, input-anomaly counts and rolling online AUC/logloss;
   ``--check`` exits 1 on a fired drift alert (or a timeline with no
   drift events at all) — the CI drift-drill gate;
+* ``incident <dir|RUN.jsonl>``— incident triage report
+  (obs/incident.py) from an evidence-bundle directory (single incident
+  or a parent of several) or a timeline's ``incident_*`` events:
+  grouped signals in first-occurrence order, cross-subsystem
+  correlation table, evidence inventory and a deterministic root-cause
+  ranking; ``--check`` exits 1 when any incident opened — the CI
+  incident-drill gate (the clean control run must exit 0);
 * ``merge RUN.jsonl [-o M.jsonl]`` — discover the per-rank shards of a
   distributed run (``RUN.jsonl.r0`` ...), align them on iteration /
   collective ``seq`` (obs/merge.py), print per-collective barrier skew,
@@ -700,6 +707,19 @@ def main(argv=None):
                    help="exit 1 when the timeline cannot be attributed "
                         "(no finished run, or no cost estimates — run "
                         "with obs_compile=true) — the CI gate")
+    p = sub.add_parser("incident",
+                       help="incident triage report: grouped signals, "
+                            "cross-subsystem correlation, evidence "
+                            "inventory, root-cause ranking "
+                            "(obs/incident.py)")
+    p.add_argument("target",
+                   help="evidence-bundle directory (one incident or a "
+                        "parent of several) or a timeline JSONL with "
+                        "incident_* events")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any incident opened — the CI "
+                        "incident-drill gate (clean control runs "
+                        "exit 0)")
     p = sub.add_parser("watch",
                        help="live-follow a growing timeline, per-rank "
                             "shard set, or a running plane's /events "
@@ -767,6 +787,17 @@ def main(argv=None):
         from .live import watch
         return watch(args.target, once=args.once, ranks=args.ranks,
                      interval_s=args.interval, max_wall_s=args.max_wall)
+
+    # incident targets may be bundle DIRECTORIES, not just timelines —
+    # they never go through load_timeline
+    if args.cmd == "incident":
+        from .incident import render_incident_report
+        try:
+            n = render_incident_report(args.target)
+        except (OSError, ValueError) as e:
+            print("error: %s" % e, file=sys.stderr)
+            return 2
+        return 1 if (args.check and n) else 0
 
     if args.cmd in ("history", "trend"):
         from .ledger import Ledger, default_ledger_dir

@@ -142,6 +142,7 @@ class Watchdog:
         self.fired = 0
         self._deadline = None          # None = disarmed
         self._fired_this_window = False
+        self._near_signaled = False    # one near-expiry per armed window
         self._wake = threading.Event()
         self._stop = False
         self._lock = threading.Lock()
@@ -158,6 +159,7 @@ class Watchdog:
             self.label = str(label)
             self._deadline = time.monotonic() + self.timeout_s
             self._fired_this_window = False
+            self._near_signaled = False
         self._wake.set()
 
     def pet(self, label=None):
@@ -166,6 +168,7 @@ class Watchdog:
                 self.label = str(label)
             self._deadline = time.monotonic() + self.timeout_s
             self._fired_this_window = False
+            self._near_signaled = False
         self._wake.set()
 
     def stop(self):
@@ -183,13 +186,34 @@ class Watchdog:
             with self._lock:
                 if self._stop:
                     return
+                now = time.monotonic()
                 expired = (self._deadline is not None
                            and not self._fired_this_window
-                           and time.monotonic() >= self._deadline)
+                           and now >= self._deadline)
+                # near-expiry at 75% of the window: an incident-engine
+                # early warning — evidence captured while the rank is
+                # merely SLOW still shows what it was stuck on when it
+                # finally hangs
+                near = (not expired and self._deadline is not None
+                        and not self._fired_this_window
+                        and not self._near_signaled
+                        and now >= self._deadline - 0.25 * self.timeout_s)
                 label = self.label
+                remaining = (self._deadline - now
+                             if self._deadline is not None else 0.0)
                 if expired:
                     self._fired_this_window = True
                     self.fired += 1
+                if near:
+                    self._near_signaled = True
+            if near:
+                try:
+                    self._obs.incident_signal(
+                        "watchdog_near_expiry",
+                        {"timeout_s": self.timeout_s, "label": label,
+                         "remaining_s": round(max(0.0, remaining), 3)})
+                except Exception:
+                    pass
             if expired:
                 self._fire(label)
 

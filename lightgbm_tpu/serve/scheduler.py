@@ -33,7 +33,10 @@ execute time) already exceeds it.  Shed requests fail fast with
 ``ServeOverloadError`` on their future; shedding is never silent — it
 counts into ``lgbm_serve_shed_total`` (by route kind and reason), the
 SLO engine's shed rate, ``stats()`` and the close-time ``serve_summary``
-event, and the queue-age gauge shows the backlog building first.
+event, and the queue-age gauge shows the backlog building first.  A
+sustained shed STORM (every ``SHED_STORM_AFTER``-th shed) additionally
+signals the incident engine (obs/incident.py), which debounces the
+repeats into one grouped incident with an evidence bundle.
 
 Observability: every completed request feeds the rolling SLO engine
 (obs/serve.py) and every Nth (``request_event_every``) emits a
@@ -64,6 +67,12 @@ from ..utils.log import Log
 # EWMA weight for the per-batch execute-time estimate behind the
 # deadline admission check (same alpha discipline as obs/health.py)
 _EWMA_ALPHA = 0.3
+
+# every Nth shed signals the incident engine (obs/incident.py): a lone
+# shed is a blip the one-time warning already covers, a run of them is
+# a storm worth an evidence bundle — the engine debounces repeats into
+# one grouped incident
+SHED_STORM_AFTER = 8
 
 
 class ServeOverloadError(RuntimeError):
@@ -199,6 +208,7 @@ class MicrobatchScheduler:
             else:
                 first = reason not in self._shed
                 self._shed[reason] = self._shed.get(reason, 0) + 1
+                shed_total = sum(self._shed.values())
         if reason is not None:
             observe_serve_shed(route, reason)
             if self.slo is not None:
@@ -208,6 +218,19 @@ class MicrobatchScheduler:
                             "protection engaged; see lgbm_serve_shed_"
                             "total for the running count", self.name,
                             route_kind(route), detail)
+            if shed_total % SHED_STORM_AFTER == 0:
+                # a storm, not a blip: every SHED_STORM_AFTER-th shed
+                # feeds the incident engine so sustained overload opens
+                # ONE grouped incident (obs/incident.py debounces);
+                # host-side dict work off the worker thread, no fence
+                try:
+                    self.observer.incident_signal(
+                        "shed_storm",
+                        {"shed_total": shed_total, "reason": reason,
+                         "route": route_kind(route),
+                         "queue_limit": self.queue_limit})
+                except Exception:
+                    pass
             fut.set_exception(ServeOverloadError(
                 "%s: request shed (%s)" % (self.name, detail), reason))
         return fut

@@ -151,6 +151,9 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_drift_threshold": "obs_drift_psi",
     "obs_fingerprint": "obs_drift_fingerprint",
     "obs_drift_k": "obs_drift_topk",
+    "obs_incidents": "obs_incident",
+    "obs_incident_window": "obs_incident_window_s",
+    "obs_incident_path": "obs_incident_dir",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -238,6 +241,9 @@ PARAMETER_SET = {
     # drift & online model-quality monitoring (obs/drift.py)
     "obs_drift_every", "obs_drift_window", "obs_drift_psi",
     "obs_drift_fingerprint", "obs_drift_topk", "obs_drift_min_labels",
+    # incident engine (obs/incident.py)
+    "obs_incident", "obs_incident_window_s", "obs_incident_dir",
+    "obs_incident_trace",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -749,6 +755,24 @@ class Config:
         # AUC/logloss emit as `online_quality` events
         # (ServingPredictor.record_outcome delayed-label channel)
         "obs_drift_min_labels": ("int", 100),
+        # incident engine (obs/incident.py): debounce + group every
+        # detector channel's anomaly signals (health, SLO burn,
+        # straggler skew, watchdog near-expiry, recompiles, drift,
+        # shed storms, operator POSTs) into schema-15 incident events,
+        # capturing a host-side evidence bundle at open
+        "obs_incident": ("bool", False),
+        # quiet seconds after the last grouped signal before the open
+        # incident closes; co-occurring signals inside the window join
+        # the SAME incident instead of opening new ones
+        "obs_incident_window_s": ("float", 5.0),
+        # evidence-bundle directory; "" anchors next to the timeline as
+        # <obs_events_path>.incidents (no bundles without an events
+        # path — incident events still land in the timeline)
+        "obs_incident_dir": ("str", ""),
+        # arm a one-iteration jax.profiler trace window when an
+        # incident opens mid-training (PR-1 trace plumbing; never armed
+        # on the serve hot path, which has no iteration to scope to)
+        "obs_incident_trace": ("bool", False),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

@@ -1,0 +1,509 @@
+"""Incident engine (lightgbm_tpu/obs/incident.py).
+
+Covers the evidence ring slice on a wrapped / concurrently-written /
+empty ring, signal classification, debounce-and-group semantics (one
+incident per co-occurrence window, quiet-window close, finalize
+close), the on-disk evidence bundle and its best-effort error path,
+the edge-triggered health warn channel (a repeating guard emits ONE
+event until a clean evaluation re-arms it), the armed one-iteration
+trace window, the live plane's /incidents listing and loopback-only
+POST control endpoints, the `obs incident` reader + --check gate, and
+the run_end digest -> ledger cells.
+"""
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from lightgbm_tpu.obs import read_events
+from lightgbm_tpu.obs.events import RingBuffer, RunObserver
+from lightgbm_tpu.obs.health import HealthMonitors
+from lightgbm_tpu.obs.incident import (classify_signal,
+                                       evidence_ring_slice,
+                                       rank_root_causes,
+                                       render_incident_report)
+from lightgbm_tpu.obs.ledger import METRIC_DIRECTIONS, metrics_from_events
+from lightgbm_tpu.obs.live import watch
+from lightgbm_tpu.obs.query import main as query_main
+
+
+def _obs(tmp_path, **kw):
+    kw.setdefault("incident", True)
+    kw.setdefault("incident_window_s", 30.0)
+    kw.setdefault("incident_dir", str(tmp_path / "bundles"))
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off", **kw)
+    obs.run_header("cpu", [{"id": 0, "kind": "cpu"}],
+                   {"num_leaves": 31}, {})
+    return obs
+
+
+def _post(url, timeout=5.0):
+    import urllib.error
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _events(obs):
+    return read_events(obs.events_path)
+
+
+# ---------------------------------------------------------- ring slice
+
+def test_ring_slice_windows_around_seq():
+    ring = RingBuffer(capacity=64)
+    for i in range(40):
+        ring.append({"ev": "iter", "it": i})
+    rows = evidence_ring_slice(ring, 30, before=5, after=3)
+    assert [r["seq"] for r in rows] == list(range(26, 34))
+    assert all(r["ev"] == "iter" for r in rows)
+
+
+def test_ring_slice_survives_wraparound():
+    ring = RingBuffer(capacity=8)
+    for i in range(100):                 # seqs 1..100, ring holds 93..100
+        ring.append({"ev": "iter", "it": i})
+    rows = evidence_ring_slice(ring, 100, before=160, after=64)
+    assert [r["seq"] for r in rows] == list(range(93, 101))
+    # a slice whose window rolled entirely off the ring is empty, not
+    # an error
+    assert evidence_ring_slice(ring, 10, before=5, after=5) == []
+
+
+def test_ring_slice_empty_ring_cold_start():
+    assert evidence_ring_slice(RingBuffer(capacity=8), 0) == []
+    assert evidence_ring_slice(RingBuffer(capacity=8), 500) == []
+
+
+def test_ring_slice_with_concurrent_writer():
+    ring = RingBuffer(capacity=32)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            ring.append({"ev": "iter", "it": i})
+            i += 1
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            rows = evidence_ring_slice(ring, ring.last_seq)
+            # never corrupt: every row carries its seq and the record,
+            # seqs strictly increasing within one slice
+            seqs = [r["seq"] for r in rows]
+            assert seqs == sorted(seqs)
+            assert all(isinstance(r.get("it"), int) for r in rows)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ------------------------------------------------------- classification
+
+def test_classify_signal():
+    assert classify_signal({"ev": "health", "status": "warn",
+                            "check": "nonfinite_gradients"}) \
+        == "nonfinite_gradients"
+    assert classify_signal({"ev": "health", "status": "fatal",
+                            "check": "loss_divergence"}) \
+        == "loss_divergence"
+    # ok verdicts and the periodic stats record are not anomalies
+    assert classify_signal({"ev": "health", "status": "ok",
+                            "check": "nonfinite_gradients"}) is None
+    assert classify_signal({"ev": "health", "status": "warn",
+                            "check": "stats"}) is None
+    # steady-state recompile fires, the first compile does not
+    assert classify_signal({"ev": "compile_attr",
+                            "sig_compiles": 2}) == "recompile"
+    assert classify_signal({"ev": "compile_attr",
+                            "sig_compiles": 1}) is None
+    assert classify_signal({"ev": "drift", "alert": "firing"}) == "drift"
+    assert classify_signal({"ev": "drift", "alert": "armed"}) is None
+    assert classify_signal({"ev": "iter", "it": 3}) is None
+
+
+# ----------------------------------------------------- group & debounce
+
+def test_cooccurring_signals_group_into_one_incident(tmp_path):
+    obs = _obs(tmp_path)
+    try:
+        obs.event("health", check="straggler_skew", status="warn",
+                  it=1, detail={"skew": 0.8})
+        obs.event("health", check="slo_burn_rate", status="warn",
+                  it=1, detail={"burn": 9.0})
+        obs.incident_signal("shed_storm", {"shed_total": 8})
+    finally:
+        obs.close()
+    evs = _events(obs)
+    opens = [e for e in evs if e["ev"] == "incident_open"]
+    closes = [e for e in evs if e["ev"] == "incident_close"]
+    assert len(opens) == 1 and len(closes) == 1
+    # first-occurrence order is preserved in the grouped close
+    assert closes[0]["signals"] == ["straggler_skew", "slo_burn_rate",
+                                    "shed_storm"]
+    assert closes[0]["counts"]["straggler_skew"] == 1
+    # incident events sort after their trigger on the timeline
+    trigger_i = next(i for i, e in enumerate(evs)
+                     if e.get("check") == "straggler_skew")
+    open_i = evs.index(opens[0])
+    assert open_i > trigger_i
+    end = [e for e in evs if e["ev"] == "run_end"][-1]
+    assert end["incidents"] == {"opened": 1, "max_signals": 3}
+
+
+def test_repeated_signal_counts_not_duplicates(tmp_path):
+    obs = _obs(tmp_path)
+    try:
+        for i in range(4):
+            obs.incident_signal("shed_storm", {"shed_total": 8 * (i + 1)})
+    finally:
+        obs.close()
+    evs = _events(obs)
+    assert len([e for e in evs if e["ev"] == "incident_open"]) == 1
+    close = [e for e in evs if e["ev"] == "incident_close"][-1]
+    assert close["signals"] == ["shed_storm"]
+    assert close["counts"]["shed_storm"] == 4
+
+
+def test_quiet_window_closes_and_next_signal_reopens(tmp_path):
+    obs = _obs(tmp_path, incident_window_s=0.1)
+    try:
+        obs.incident_signal("shed_storm", {"shed_total": 8})
+        time.sleep(0.25)
+        # any timeline event ticks the quiet-window close
+        obs.event("memory", it=1, devices=[])
+        obs.flush()
+        evs_mid = _events(obs)
+        assert [e["ev"] for e in evs_mid
+                if e["ev"].startswith("incident_")][-1] == "incident_close"
+        time.sleep(0.25)
+        obs.incident_signal("operator", None)
+    finally:
+        obs.close()
+    evs = _events(obs)
+    opens = [e for e in evs if e["ev"] == "incident_open"]
+    assert len(opens) == 2
+    assert opens[0]["id"] != opens[1]["id"]
+    end = [e for e in evs if e["ev"] == "run_end"][-1]
+    assert end["incidents"]["opened"] == 2
+
+
+def test_clean_run_digests_zero(tmp_path):
+    obs = _obs(tmp_path)
+    try:
+        obs.iter_begin(0)
+        obs.iter_end(0)
+    finally:
+        obs.close()
+    evs = _events(obs)
+    assert not [e for e in evs if e["ev"].startswith("incident_")]
+    end = [e for e in evs if e["ev"] == "run_end"][-1]
+    # zeros are RECORDED (not omitted) so the ledger cell has a real
+    # zero history to change-point against
+    assert end["incidents"] == {"opened": 0, "max_signals": 0}
+
+
+def test_incident_signal_none_when_engine_off(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off")
+    try:
+        assert obs.incident_signal("shed_storm") is None
+        assert obs.incidents() == {"enabled": False, "open": [],
+                                   "closed": []}
+    finally:
+        obs.close()
+    end = [e for e in _events(obs) if e["ev"] == "run_end"][-1]
+    assert "incidents" not in end
+
+
+# ------------------------------------------------------ evidence bundle
+
+def test_evidence_bundle_on_disk(tmp_path):
+    obs = _obs(tmp_path)
+    try:
+        obs.iter_begin(0)
+        obs.iter_end(0)
+        obs.stamp_context(stage="boost", it=0)
+        obs.event("health", check="nonfinite_gradients", status="warn",
+                  it=0, detail={"grad_abs_mean": "nan"})
+    finally:
+        obs.close()
+    evs = _events(obs)
+    open_rec = [e for e in evs if e["ev"] == "incident_open"][0]
+    inc_dir = open_rec["dir"]
+    assert os.path.isdir(inc_dir)
+    arts = {e["artifact"]: e for e in evs
+            if e["ev"] == "incident_evidence"}
+    for need in ("ring", "metrics", "flight_context", "statusz",
+                 "threads", "ring_post"):
+        assert need in arts, arts
+        assert "error" not in arts[need]
+        assert os.path.isfile(arts[need]["path"])
+        assert arts[need]["bytes"] > 0
+    # the ring slice holds the lead-up, the meta carries the rollup
+    with open(os.path.join(inc_dir, "ring.jsonl")) as f:
+        ring_rows = [json.loads(ln) for ln in f]
+    assert any(r.get("ev") == "iter" for r in ring_rows)
+    with open(os.path.join(inc_dir, "incident.json")) as f:
+        meta = json.load(f)
+    assert meta["status"] == "closed"
+    assert meta["signals"] == ["nonfinite_gradients"]
+    assert {a["artifact"] for a in meta["artifacts"]} >= {"ring",
+                                                          "metrics"}
+    # the statusz snapshot carries the stamped iteration context
+    with open(os.path.join(inc_dir, "statusz.json")) as f:
+        snap = json.load(f)
+    assert snap.get("context", {}).get("stage") == "boost"
+
+
+def test_evidence_capture_is_best_effort(tmp_path):
+    # an unwritable bundle dir must degrade to error-stamped evidence
+    # events, never an exception into the run
+    blocker = tmp_path / "bundles"
+    blocker.write_text("a file where the dir should go")
+    obs = _obs(tmp_path, incident_dir=str(blocker))
+    try:
+        obs.incident_signal("operator", None)
+    finally:
+        obs.close()
+    evs = _events(obs)
+    assert len([e for e in evs if e["ev"] == "incident_open"]) == 1
+    errs = [e for e in evs if e["ev"] == "incident_evidence"
+            and e.get("error")]
+    assert errs, "failed captures must surface as error-stamped events"
+    assert [e for e in evs if e["ev"] == "incident_close"]
+
+
+# ------------------------------------------- health warn edge-triggering
+
+def test_repeating_warn_dedups_to_one_event(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off")
+    hm = HealthMonitors(mode="warn")
+    problem = [("nonfinite_gradients", {"grad_abs_mean": "nan"})]
+    try:
+        # the guard fires every iteration while gradients stay bad —
+        # only the TRANSITION reaches the timeline
+        for it in range(5):
+            hm._resolve(obs, it, problem,
+                        evaluated=("nonfinite_gradients",))
+        # a clean evaluation re-arms the check ...
+        hm._resolve(obs, 5, [], evaluated=("nonfinite_gradients",))
+        # ... so the next firing is a new transition
+        hm._resolve(obs, 6, problem,
+                    evaluated=("nonfinite_gradients",))
+    finally:
+        obs.close()
+    health = [e for e in _events(obs) if e["ev"] == "health"
+              and e.get("check") == "nonfinite_gradients"]
+    assert len(health) == 2
+    assert [e["it"] for e in health] == [0, 6]
+
+
+def test_unevaluated_checks_stay_latched(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off")
+    hm = HealthMonitors(mode="warn")
+    try:
+        hm._resolve(obs, 0, [("memory_watermark", {"frac": 0.95})],
+                    evaluated=("memory_watermark",))
+        # an evaluation of OTHER checks must not re-arm this one
+        hm._resolve(obs, 1, [], evaluated=("nonfinite_gradients",))
+        hm._resolve(obs, 2, [("memory_watermark", {"frac": 0.96})],
+                    evaluated=("memory_watermark",))
+    finally:
+        obs.close()
+    mem = [e for e in _events(obs) if e["ev"] == "health"
+           and e.get("check") == "memory_watermark"]
+    assert len(mem) == 1
+
+
+# -------------------------------------------------- armed trace window
+
+def test_incident_trace_arms_one_iteration(tmp_path, monkeypatch):
+    calls = []
+    from lightgbm_tpu.obs import profile
+    monkeypatch.setattr(profile, "_start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(profile, "_stop_trace",
+                        lambda: calls.append(("stop",)))
+    obs = _obs(tmp_path, incident_trace=True)
+    try:
+        obs.iter_begin(0)
+        obs.event("health", check="nonfinite_gradients", status="warn",
+                  it=0, detail={})
+        # armed at open, NOT started mid-iteration
+        assert calls == []
+        obs.iter_end(0)
+        obs.iter_begin(1)               # window opens here ...
+        assert calls and calls[0][0] == "start"
+        obs.iter_end(1)                 # ... and closes here
+        assert calls[-1] == ("stop",)
+    finally:
+        obs.close()
+    assert len(calls) == 2, "trace must scope exactly one iteration"
+    evs = _events(obs)
+    tw = [e for e in evs if e["ev"] == "trace_window"]
+    assert [e["action"] for e in tw] == ["start", "stop"]
+    assert any(e["ev"] == "incident_evidence"
+               and e.get("artifact") == "trace" for e in evs)
+
+
+def test_no_trace_outside_training(tmp_path, monkeypatch):
+    calls = []
+    from lightgbm_tpu.obs import profile
+    monkeypatch.setattr(profile, "_start_trace",
+                        lambda d: calls.append(d))
+    obs = _obs(tmp_path, incident_trace=True)
+    try:
+        obs._lifecycle = "serve"
+        obs.incident_signal("shed_storm", {"shed_total": 8})
+    finally:
+        obs.close()
+    assert calls == [], "serve-path incidents must never arm a trace"
+
+
+# ------------------------------------------------------------ live plane
+
+def test_incidents_endpoint_and_post_control(tmp_path):
+    obs = _obs(tmp_path, http_port=0)
+    try:
+        url = obs.live_url
+        assert url.startswith("http://127.0.0.1:")
+        with urllib.request.urlopen(url + "/incidents", timeout=5) as r:
+            listing = json.loads(r.read().decode())
+        assert listing == {"enabled": True, "opened": 0, "open": [],
+                           "closed": []}
+        code, body = _post(url + "/trigger/incident")
+        assert code == 200 and body["triggered"] == "incident"
+        iid = body["id"]
+        with urllib.request.urlopen(url + "/incidents", timeout=5) as r:
+            listing = json.loads(r.read().decode())
+        assert listing["open"] and listing["open"][0]["id"] == iid
+        assert listing["open"][0]["signals"] == ["operator"]
+        code, body = _post(url + "/trigger/flight")
+        assert code == 200 and os.path.isfile(body["path"])
+        code, _ = _post(url + "/trigger/nope")
+        assert code == 404
+        # the open incident rides /statusz via the flight provider
+        with urllib.request.urlopen(url + "/statusz", timeout=5) as r:
+            sz = json.loads(r.read().decode())
+        assert sz["flight"]["incidents"]["open"] == 1
+        assert sz["flight"]["incidents"]["last"]["id"] == iid
+    finally:
+        obs.close()
+
+
+def test_post_trigger_incident_409_when_engine_off(tmp_path):
+    obs = RunObserver(events_path=str(tmp_path / "ev.jsonl"),
+                      timing="off", http_port=0)
+    obs.run_header("cpu", [{"id": 0, "kind": "cpu"}], {}, {})
+    try:
+        code, body = _post(obs.live_url + "/trigger/incident")
+        assert code == 409
+        with urllib.request.urlopen(obs.live_url + "/incidents",
+                                    timeout=5) as r:
+            assert json.loads(r.read().decode())["enabled"] is False
+    finally:
+        obs.close()
+
+
+# ------------------------------------------------------------- reader
+
+def _fault_timeline(tmp_path):
+    obs = _obs(tmp_path)
+    try:
+        obs.iter_begin(0)
+        obs.iter_end(0)
+        obs.event("health", check="straggler_skew", status="warn",
+                  it=0, detail={"skew": 0.9})
+        obs.event("health", check="slo_burn_rate", status="warn",
+                  it=0, detail={"burn": 9.0})
+    finally:
+        obs.close()
+    return obs
+
+
+def test_render_report_from_timeline_and_bundle(tmp_path, capsys):
+    obs = _fault_timeline(tmp_path)
+    out = io.StringIO()
+    n = render_incident_report(obs.events_path, out=out)
+    text = out.getvalue()
+    assert n == 1
+    assert "straggler_skew" in text and "slo_burn_rate" in text
+    assert "root-cause ranking" in text
+    assert "straggler-induced latency" in text.splitlines()[
+        next(i for i, ln in enumerate(text.splitlines())
+             if "root-cause ranking" in ln) + 1]
+    # first-occurrence ordering in the correlation table
+    assert text.index("straggler_skew") < text.index("slo_burn_rate")
+    # same report from the bundle directory (parent of all incidents)
+    out2 = io.StringIO()
+    n2 = render_incident_report(str(tmp_path / "bundles"), out=out2)
+    assert n2 == 1
+    assert "evidence" in out2.getvalue()
+    # the CLI gate: fault exits 1 under --check, 0 without
+    assert query_main(["incident", obs.events_path, "--check"]) == 1
+    assert query_main(["incident", obs.events_path]) == 0
+    capsys.readouterr()
+
+
+def test_check_gate_clean_and_error(tmp_path, capsys):
+    obs = _obs(tmp_path)
+    obs.close()
+    assert query_main(["incident", obs.events_path, "--check"]) == 0
+    assert query_main(["incident",
+                       str(tmp_path / "missing.jsonl"), "--check"]) == 2
+    capsys.readouterr()
+
+
+def test_root_cause_ranking_deterministic():
+    ranked = rank_root_causes(["straggler_skew", "slo_burn_rate"],
+                              {"straggler_skew": 2, "slo_burn_rate": 3})
+    # the 2-kind match outranks every 1-kind match
+    assert ranked[0][0].startswith("straggler-induced latency")
+    assert ranked[0][1] == ["slo_burn_rate", "straggler_skew"]
+    assert ranked == rank_root_causes(
+        ["slo_burn_rate", "straggler_skew"],
+        {"straggler_skew": 2, "slo_burn_rate": 3})
+    # unknown signal sets fall back, never raise
+    fallback = rank_root_causes(["mystery_check"], {})
+    assert len(fallback) == 1 and "no heuristic" in fallback[0][0]
+
+
+def test_watch_renders_incident_lines(tmp_path):
+    obs = _fault_timeline(tmp_path)
+    out = io.StringIO()
+    assert watch(obs.events_path, once=True, out=out) == 0
+    text = out.getvalue()
+    assert "INCIDENT OPEN" in text
+    assert "INCIDENT CLOSE" in text
+
+
+# ------------------------------------------------------------- ledger
+
+def test_ledger_cells_from_run_end_digest(tmp_path):
+    obs = _fault_timeline(tmp_path)
+    m = metrics_from_events(_events(obs))
+    assert m["incidents_opened"] == 1
+    assert m["incident_max_signals"] == 2
+    assert METRIC_DIRECTIONS["incidents_opened"] == -1
+    assert METRIC_DIRECTIONS["incident_max_signals"] == -1
+
+
+def test_ledger_cells_fallback_without_digest():
+    evs = [{"ev": "incident_open", "id": "r-001", "t": 1.0},
+           {"ev": "incident_close", "id": "r-001", "t": 2.0,
+            "signals": ["shed_storm", "slo_burn_rate"]},
+           {"ev": "run_end", "iters": 0, "t": 3.0}]
+    m = metrics_from_events(evs)
+    assert m["incidents_opened"] == 1
+    assert m["incident_max_signals"] == 2

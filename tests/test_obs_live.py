@@ -1,6 +1,6 @@
 """Live observability plane (lightgbm_tpu/obs/live.py).
 
-Covers the in-run HTTP scrape server (all four endpoints, ephemeral
+Covers the in-run HTTP scrape server (all read endpoints, ephemeral
 port-0 binding, teardown at run_end, the /healthz 503 flip on a fatal
 health verdict, the /events cursor protocol), the `obs watch` live
 tail (single file, growing file with a concurrent writer, multi-rank
@@ -146,7 +146,8 @@ def test_unknown_route_404_and_index(tmp_path):
         code, _, body = _get(obs.live_url + "/")
         assert code == 200
         assert set(json.loads(body)["endpoints"]) == {
-            "/metrics", "/healthz", "/statusz", "/events"}
+            "/metrics", "/healthz", "/statusz", "/events", "/incidents",
+            "POST /trigger/flight", "POST /trigger/incident"}
     finally:
         obs.close()
 

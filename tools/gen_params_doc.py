@@ -291,6 +291,25 @@ NOTES = {
                             "required before an `online_quality` "
                             "event (rolling online AUC/logloss vs the "
                             "training-time eval reference) is emitted",
+    "obs_incident": "arm the incident engine (obs/incident.py): "
+                    "detector signals — health warnings, SLO burn, "
+                    "drift alerts, shed storms, watchdog near-expiry, "
+                    "steady-state recompiles — are debounced and "
+                    "grouped into `incident_open`/`incident_close` "
+                    "events with an evidence bundle captured at open",
+    "obs_incident_window_s": "debounce window: signals arriving within "
+                             "this many seconds of the incident's last "
+                             "signal join the same incident; a quiet "
+                             "window closes it",
+    "obs_incident_dir": "directory for evidence bundles (one "
+                        "subdirectory per incident: ring slice, "
+                        "metrics snapshot, statusz snapshot, flight "
+                        "context, thread stacks); empty = alongside "
+                        "`obs_events_path` + `.incidents`",
+    "obs_incident_trace": "arm a one-iteration `jax.profiler` trace "
+                          "window when an incident opens mid-training "
+                          "(never on the serve hot path); the trace "
+                          "lands in the evidence bundle",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -377,7 +396,9 @@ GROUPS = [
         "obs_roofline_peaks", "obs_http_port", "obs_http_addr",
         "obs_drift_every", "obs_drift_window", "obs_drift_psi",
         "obs_drift_fingerprint", "obs_drift_topk",
-        "obs_drift_min_labels"]),
+        "obs_drift_min_labels", "obs_incident",
+        "obs_incident_window_s", "obs_incident_dir",
+        "obs_incident_trace"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",
