@@ -755,6 +755,45 @@ def dry():
         assert mine[0]["schema"] and "provenance" in \
             next(e for e in evs if e["ev"] == "run_header"), \
             "run_header missing provenance (schema 10)"
+
+    # continuous host profiler (obs/prof.py, schema 16): the default
+    # obs_prof_hz armed the sampler for the instrumented run above, so
+    # its timeline must carry >=1 window whose hottest folded stack is
+    # in-tree code, with the self-measured overhead inside the 1%
+    # budget — the same gate CI re-checks via `obs prof --check`
+    from lightgbm_tpu.obs.prof import (OVERHEAD_BUDGET_FRAC, burst,
+                                       check_profiles, merged_profile,
+                                       profile_events)
+    profs = profile_events(evs)
+    assert profs, "obs_prof_hz default run emitted no prof_profile " \
+        "windows (sampler never armed?)"
+    prof_merged = merged_profile(profs)
+    assert prof_merged["samples"] > 0 and prof_merged["stacks"], \
+        "prof_profile windows carry no samples: %r" % prof_merged
+    top_stack = max(prof_merged["stacks"].items(),
+                    key=lambda kv: (kv[1], kv[0]))[0]
+    assert "lightgbm_tpu/" in top_stack, \
+        "top folded stack is not in-tree code: %r" % top_stack
+    assert prof_merged["overhead_frac"] < OVERHEAD_BUDGET_FRAC, \
+        "sampling overhead %.4f blew the %.2f%% budget" \
+        % (prof_merged["overhead_frac"], 100 * OVERHEAD_BUDGET_FRAC)
+    prof_problems = check_profiles(evs)
+    assert not prof_problems, \
+        "obs prof --check would fail the clean timeline: %r" \
+        % prof_problems
+    # sampling is pure host work: a synchronous burst capture must not
+    # issue a single host<->device sync
+    fences_prof = obs_timers.fence_count()
+    burst(seconds=0.2)
+    assert obs_timers.fence_count() == fences_prof, \
+        "profiler burst issued host sync(s) — sampling must be free"
+    # and the ledger recorded the overhead as a gated cell for
+    # `obs trend --check`
+    if ledger_dir:
+        assert mine[0]["metrics"].get("prof_overhead_frac") is not None, \
+            "ledger record missing the prof_overhead_frac cell: %r" \
+            % mine[0]["metrics"]
+
     print(json.dumps({"status": "dry_ok", "events": len(evs),
                       "iters": len(iter_recs), "health": len(health),
                       "metrics": len(metric_recs),
@@ -765,6 +804,9 @@ def dry():
                       "dataset_construct": len(cons),
                       "utilization": len(util_recs),
                       "fused_iters": len(fused_iters),
+                      "prof_windows": len(profs),
+                      "prof_overhead_frac": round(
+                          prof_merged["overhead_frac"], 6),
                       "mid_tree_syncs": 0,
                       "live_scrape_events": live_scrapes.get("events", 0),
                       "path": obs_path}))

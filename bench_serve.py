@@ -206,13 +206,18 @@ def main(argv=None):
                       # run must open ZERO (asserted below)
                       incident=(args.dry or args.overload),
                       incident_window_s=10.0,
-                      incident_dir=obs_path + ".incidents")
+                      incident_dir=obs_path + ".incidents",
+                      # continuous host profiler: the serve worker's
+                      # queue/encode/execute split shows up as folded
+                      # stacks under the lgbm-*-microbatch role
+                      prof_hz=29, prof_window_s=5.0)
     obs.run_header(backend=jax.default_backend(),
                    devices=[str(d) for d in jax.local_devices()],
                    params={"requests": requests, "threads": args.threads,
                            "max_delay_ms": args.max_delay_ms,
                            "max_batch": args.max_batch},
                    context={"tool": "bench_serve"})
+    obs.prof_arm()                      # obs.close() disarms + flushes
 
     # request-size mix: singletons up to full buckets, so the deadline
     # flush, padding, and every bucket rung all see traffic

@@ -200,6 +200,13 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 finished_iteration = booster.best_iteration
                 break
     except BaseException:
+        # stop the sampling profiler before the interpreter unwinds the
+        # raising stack (close() disarms too, but only after the trace
+        # teardown — the sampler must not walk dying frames first)
+        try:
+            booster._gbdt._obs.prof_disarm()
+        except Exception:
+            pass
         # a crashed run still finalizes its timeline: run_end
         # lands with status='aborted' and the writer flushes
         booster.finalize_telemetry(status="aborted")

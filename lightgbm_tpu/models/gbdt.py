@@ -174,6 +174,10 @@ class GBDT:
             collective_info = getattr(self.learner, "collective_info", None)
             if collective_info is not None:
                 self._obs.event("collectives", **collective_info())
+            # arm the continuous host sampling profiler (obs/prof.py,
+            # obs_prof_hz) for the run; finalize_telemetry -> obs.close()
+            # disarms and flushes the final prof_profile window
+            self._obs.prof_arm()
             # registry instruments are only touched when the observer is
             # on — the disabled hot path stays allocation-free (pinned by
             # the overhead guard in tests/test_obs.py)

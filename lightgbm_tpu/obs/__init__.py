@@ -72,9 +72,18 @@ GPU Accelerated Learning" ground their claims in, built into the loop):
   rollup, /statusz snapshot, thread stacks, optional one-iteration
   armed profiler trace), and renders the ``obs incident`` triage
   report with cross-subsystem correlation and root-cause ranking;
+* ``prof``    — continuous host sampling profiler (``obs_prof_hz``,
+  default ~29 Hz, off at 0): a daemon thread walks
+  ``sys._current_frames()`` on a jittered monotonic clock, folds each
+  thread's stack into Brendan-Gregg collapsed-stack counts tagged with
+  the live stage/phase/iteration/thread-role context, and rolls windows
+  into schema-16 ``prof_profile`` events with a self-measured
+  ``overhead_frac`` gated at <1%; read back via ``obs prof``
+  (top-table, ``--flame`` HTML flamegraph, ``--check`` budget gate)
+  and on demand via the live plane's ``GET /prof?seconds=N``;
 * ``query``   — the one timeline reader behind ``python -m lightgbm_tpu
   obs summary|recompiles|stragglers|explain|roofline|serve|drift|
-  incident|merge|diff|trace|watch``;
+  incident|merge|diff|trace|watch|prof``;
 * ``merge``   — cross-rank merge of per-rank timeline shards: barrier
   skew per host collective (aligned on ``seq``), per-rank phase
   comparison, slowest-rank attribution, and a merged critical-path
@@ -107,7 +116,8 @@ Config surface (utils/config.py): ``obs_events_path``, ``obs_timing``,
 ``obs_http_addr``, ``obs_drift_every``, ``obs_drift_window``,
 ``obs_drift_psi``, ``obs_drift_fingerprint``, ``obs_drift_topk``,
 ``obs_drift_min_labels``, ``obs_incident``, ``obs_incident_window_s``,
-``obs_incident_dir``, ``obs_incident_trace``.
+``obs_incident_dir``, ``obs_incident_trace``, ``obs_prof_hz``,
+``obs_prof_window_s``, ``obs_prof_topk``.
 See docs/Observability.md for the schema.
 """
 from __future__ import annotations
@@ -255,4 +265,14 @@ def observer_from_config(config, comm=None):
                        incident_dir=str(
                            getattr(config, "obs_incident_dir", "") or ""),
                        incident_trace=bool(
-                           getattr(config, "obs_incident_trace", False)))
+                           getattr(config, "obs_incident_trace", False)),
+                       # the profiler piggybacks on an otherwise-enabled
+                       # observer; its default never flips the NULL
+                       # short-circuit above
+                       prof_hz=int(
+                           getattr(config, "obs_prof_hz", 29) or 0),
+                       prof_window_s=float(
+                           getattr(config, "obs_prof_window_s", 5.0)
+                           or 5.0),
+                       prof_topk=int(
+                           getattr(config, "obs_prof_topk", 20) or 20))

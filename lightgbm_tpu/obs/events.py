@@ -76,6 +76,11 @@ incident_evidence id, artifact (schema 15; one captured bundle artifact
 incident_close id, duration_s, signals (schema 15; the quiet-window
                close with per-kind counts in first-occurrence order —
                the correlation table `obs incident` renders)
+prof_profile   samples, dur_s, hz, cost_s (schema 16; obs/prof.py — one
+               aggregated window of the continuous host sampling
+               profiler: top-K folded stacks + truncated tail, per-
+               role/stage/phase totals, self-measured overhead — the
+               gated budget `obs prof --check` enforces)
 run_end        iters, phase_totals, entries (+ status: ok|aborted)
 =============  =========================================================
 
@@ -114,7 +119,7 @@ from .profile import TraceWindow
 from .timers import EntryTimers, PhaseClock, fence
 from ..utils.log import Log
 
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 # schema 1 (no health/metrics), 2 (no compile_attr/straggler),
 # 3 (rank-less, no host_collective), 4 (no model/data events),
 # 5 (no serving events), 6 (no request traces / SLO snapshots),
@@ -131,11 +136,14 @@ SCHEMA_VERSION = 15
 # events and the serve_summary ``drift`` digest, obs/drift.py) and
 # 14 (no incident engine — schema 15 adds the ``incident_open`` /
 # ``incident_evidence`` / ``incident_close`` anomaly-correlation
-# events and the run_end ``incidents`` digest, obs/incident.py)
-# timelines still parse.  wave_band_escape stays accepted for old
-# timelines even though nothing emits it anymore (the band prior died
-# in PR-11; ops/pallas_wave.py tile planner post-mortem).
-_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15)
+# events and the run_end ``incidents`` digest, obs/incident.py) and
+# 15 (no host profiler — schema 16 adds the continuous sampling
+# profiler's ``prof_profile`` window rollup, obs/prof.py) timelines
+# still parse.  wave_band_escape stays accepted for old timelines
+# even though nothing emits it anymore (the band prior died in PR-11;
+# ops/pallas_wave.py tile planner post-mortem).
+_ACCEPTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+                     16)
 
 # ev -> keys that must be present (beyond the common ev/t/run)
 _REQUIRED = {
@@ -217,6 +225,11 @@ _REQUIRED = {
     "incident_open": ("id", "trigger", "signals"),
     "incident_evidence": ("id", "artifact"),
     "incident_close": ("id", "duration_s", "signals"),
+    # schema 16 (obs/prof.py): the continuous host sampling profiler —
+    # one aggregated window per obs_prof_window_s with the folded-stack
+    # counts and the sampler's self-measured cost (the overhead budget
+    # bench.py --dry and `obs prof --check` gate on)
+    "prof_profile": ("samples", "dur_s", "hz", "cost_s"),
     "run_end": ("iters", "phase_totals", "entries"),
 }
 
@@ -307,6 +320,14 @@ _OPTIONAL = {
     "incident_evidence": ("path", "bytes", "error", "it"),
     "incident_close": ("counts", "artifacts", "signal_detail", "dir",
                        "it", "window_s"),
+    # schema 16: the window's top-K folded stacks (+ how many samples
+    # the truncation dropped), per-thread-role / loop-stage / phase
+    # sample totals, the iteration span covered, the self-measured
+    # overhead fraction, and — on a wedged sampler — the error that
+    # stopped it (``obs prof --check`` fails loud on it)
+    "prof_profile": ("stacks", "truncated", "topk", "roles", "stages",
+                     "phases", "iter_lo", "iter_hi", "overhead_frac",
+                     "error", "source"),
     "run_end": ("status", "health", "compile_attr", "stragglers",
                 # obs/merge.py merged-timeline summary
                 "rank_report",
@@ -683,6 +704,12 @@ class NullObserver:
     def stamp_context(self, **fields):
         pass
 
+    def prof_arm(self):
+        return None
+
+    def prof_disarm(self):
+        pass
+
     def iter_begin(self, it):
         pass
 
@@ -742,7 +769,8 @@ class RunObserver(NullObserver):
                  utilization_every=0, roofline_peaks="",
                  http_port=None, http_addr="127.0.0.1",
                  incident=False, incident_window_s=5.0,
-                 incident_dir="", incident_trace=False):
+                 incident_dir="", incident_trace=False,
+                 prof_hz=0, prof_window_s=5.0, prof_topk=20):
         from . import metrics as metrics_mod
         if rank is None or world_size is None:
             info = _default_rank_info()
@@ -825,6 +853,14 @@ class RunObserver(NullObserver):
                 self, window_s=float(incident_window_s or 5.0),
                 bundle_dir=str(incident_dir or ""),
                 trace=bool(incident_trace))
+        # continuous host sampling profiler (obs/prof.py, schema 16):
+        # constructed lazily by prof_arm() — the training loop arms it
+        # at run start (models/gbdt.py) and close() disarms, flushing
+        # the final window before run_end
+        self._prof = None
+        self._prof_hz = max(0, int(prof_hz or 0))
+        self._prof_window_s = float(prof_window_s or 5.0)
+        self._prof_topk = max(1, int(prof_topk or 20))
         self._live = None
         if http_port is not None and int(http_port) >= 0:
             self.ensure_live_server(int(http_port), http_addr)
@@ -1066,9 +1102,36 @@ class RunObserver(NullObserver):
 
     def stamp_context(self, **fields):
         """Update the host-side run-context dict (iteration, tree count,
-        loop stage) that /statusz and incident evidence bundles read —
-        a plain dict update, never a fence."""
+        loop stage) that /statusz, incident evidence bundles and the
+        sampling profiler's stage tags read — a plain dict update,
+        never a fence."""
         self._run_context.update(fields)
+
+    # -- continuous host profiler (obs/prof.py, schema 16) --------------
+    def prof_arm(self):
+        """Start the sampling profiler when ``obs_prof_hz > 0``
+        (idempotent — the daemon thread is constructed once and
+        restarted if a previous disarm stopped it).  Returns the
+        profiler, or None when sampling is off or the observer closed."""
+        if self._prof_hz <= 0 or self._closed:
+            return None
+        if self._prof is None:
+            from .prof import HostProfiler
+            self._prof = HostProfiler(
+                emit=self.event, hz=self._prof_hz,
+                window_s=self._prof_window_s, topk=self._prof_topk,
+                context=self._run_context,
+                phase_of=lambda: self._clock.current,
+                iter_of=lambda: self._last_it)
+        self._prof.start()
+        return self._prof
+
+    def prof_disarm(self):
+        """Stop the sampler and flush its final partial window as a
+        ``prof_profile`` event (idempotent; ``close()`` calls this
+        before ``run_end`` so the last window sorts inside the run)."""
+        if self._prof is not None:
+            self._prof.stop()
 
     # -- misc ----------------------------------------------------------
     def memory_snapshot(self, it):
@@ -1100,6 +1163,13 @@ class RunObserver(NullObserver):
         except Exception:
             pass
         self._trace.force_stop(self)
+        # stop the sampling profiler and flush its final window BEFORE
+        # run_end so the last prof_profile sorts inside the run (and the
+        # ledger's prof_overhead_frac cell sees every window)
+        try:
+            self.prof_disarm()
+        except Exception:
+            pass
         # close any open incident BEFORE run_end so incident_close sorts
         # inside the run; the digest rides on run_end (zeros included)
         incidents_digest = None

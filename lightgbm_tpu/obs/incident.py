@@ -468,6 +468,17 @@ class IncidentEngine:
         except Exception as e:
             stacks = [{"error": repr(e)}]
         self._artifact(inc, "threads", "threads.json", stacks)
+        # sampled profile window (obs/prof.py): WHERE the host was
+        # spending time across the anomaly, not just the one-shot
+        # stacks above.  Prefers the live profiler's current window
+        # (free — samples already collected); falls back to a short
+        # synchronous burst when the sampler is off.
+        try:
+            from .prof import evidence_profile
+            profile = evidence_profile(obs)
+        except Exception as e:
+            profile = {"error": repr(e)}
+        self._artifact(inc, "profile", "profile.json", profile)
         self._write_meta(inc, inc.meta("open", window_s=self.window_s))
 
     def _capture_close_evidence(self, inc):

@@ -92,6 +92,12 @@ METRIC_DIRECTIONS = {
     # the git rev that introduced it
     "incidents_opened": -1,
     "incident_max_signals": -1,
+    # schema 16 host profiler (obs/prof.py): the sampler's self-measured
+    # cost as a fraction of profiled wall time — a commit that makes
+    # sampling more expensive (deeper stacks, more threads) drifts this
+    # cell up, and `obs trend --check` catches it before the 1% budget
+    # gate in bench.py --dry ever trips
+    "prof_overhead_frac": -1,
 }
 
 # noise floors under the MAD estimate: a flat history has MAD 0, and a
@@ -216,6 +222,14 @@ def metrics_from_events(events):
             if closes:
                 out["incident_max_signals"] = max(
                     len(e.get("signals") or ()) for e in closes)
+    # schema 16: exec-weighted sampling overhead across every profiler
+    # window — sum(cost)/sum(duration), not a mean of per-window
+    # fractions, so a long cheap window cannot mask a short hot one
+    profs = [e for e in events if e.get("ev") == "prof_profile"]
+    dur = sum(float(e.get("dur_s", 0.0) or 0.0) for e in profs)
+    if dur > 0:
+        out["prof_overhead_frac"] = (
+            sum(float(e.get("cost_s", 0.0) or 0.0) for e in profs) / dur)
     return out
 
 

@@ -22,7 +22,12 @@ Every other obs surface is post-hoc — a JSONL timeline analyzed after
   behind ``obs watch <url>``;
 * ``/incidents`` — open/closed incident listing from the incident
   engine (obs/incident.py), including each incident's grouped signals
-  and evidence inventory.
+  and evidence inventory;
+* ``/prof?seconds=N`` — on-demand host profile burst (obs/prof.py):
+  a synchronous collapsed-stack capture of every thread except the
+  handler's own, rendered as Brendan-Gregg folded text.  Loopback
+  peers only (the same rule as the POST controls): a capture spends
+  real sampling time on the host it profiles.
 
 Schema 15 adds operator CONTROL alongside the reads: ``POST
 /trigger/flight`` dumps a flight record on demand and ``POST
@@ -216,10 +221,32 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                            headers=(("X-Obs-Next-After", str(seq)),))
             elif route == "/incidents":
                 self._send_json(200, obs.incidents())
+            elif route == "/prof":
+                # on-demand host profile burst (obs/prof.py).  Loopback
+                # peers only, like the POST controls: the capture spends
+                # real sampling time on the host it profiles
+                if not self._loopback_peer():
+                    self._send_json(403, {"error": "/prof accepts "
+                                                   "loopback peers only"})
+                else:
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        seconds = float(q.get("seconds", ["0.25"])[0])
+                    except ValueError:
+                        seconds = 0.25
+                    seconds = max(0.05, min(5.0, seconds))
+                    from .prof import burst, folded_text
+                    payload = burst(
+                        seconds=seconds,
+                        context=getattr(obs, "_run_context", None),
+                        source="live")
+                    self._send(200, "text/plain; charset=utf-8",
+                               folded_text(payload))
             elif route == "/":
                 self._send_json(200, {"endpoints": ["/metrics", "/healthz",
                                                     "/statusz", "/events",
                                                     "/incidents",
+                                                    "/prof?seconds=N",
                                                     "POST /trigger/flight",
                                                     "POST /trigger/incident"],
                                       "run": getattr(obs, "run_id", None)})

@@ -64,7 +64,14 @@ Subcommands (``python -m lightgbm_tpu obs <cmd> ...``):
   compile/health/shed events and SLO verdicts as they happen; tails a
   single file, every ``.rN`` shard of a pod run (``--ranks``, aligned
   per iteration), or a running plane's ``/events`` URL
-  (``obs_http_port``); ``--once`` renders the current state and exits.
+  (``obs_http_port``); ``--once`` renders the current state and exits;
+* ``prof <timeline|dir> [--check] [--flame F.html] [--top N]`` — host
+  profile report (obs/prof.py) from the ``prof_profile`` windows: a
+  merged top-table of folded stacks with stage/phase/thread-role
+  attribution, an optional self-contained HTML flamegraph, and the
+  overhead gate — ``--check`` exits 1 on a blown sampling budget
+  (>1%), a window that saw zero samples while iterations advanced, or
+  a sampler ``error`` window — the CI profiler-liveness gate.
 
 Schema v1/v2 timelines load unchanged — the new event types simply
 don't appear.
@@ -738,6 +745,22 @@ def main(argv=None):
     p.add_argument("--max-wall", type=float, default=0.0,
                    help="follow-mode wall-clock limit in seconds for "
                         "scripted callers (0 = no limit)")
+    p = sub.add_parser("prof",
+                       help="host profile report: merged top-table of "
+                            "folded stacks, HTML flamegraph, overhead "
+                            "gate (obs/prof.py)")
+    p.add_argument("target",
+                   help="timeline JSONL with prof_profile windows, or "
+                        "a directory (newest *.jsonl inside)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on blown overhead budget (>1%%), a "
+                        "zero-sample window while iterations advanced, "
+                        "or a sampler error window — the CI "
+                        "profiler-liveness gate")
+    p.add_argument("--flame", default="",
+                   help="write a self-contained HTML flamegraph here")
+    p.add_argument("--top", type=int, default=20,
+                   help="rows in the terminal top-table (default 20)")
     p = sub.add_parser("merge", help="cross-rank merge + skew analysis "
                                      "of per-rank shards")
     p.add_argument("shards", nargs="+",
@@ -798,6 +821,19 @@ def main(argv=None):
             print("error: %s" % e, file=sys.stderr)
             return 2
         return 1 if (args.check and n) else 0
+
+    # prof targets may be directories too (newest *.jsonl inside) —
+    # resolved in obs/prof.py, not through load_timeline here
+    if args.cmd == "prof":
+        from .prof import render_prof_report
+        try:
+            problems = render_prof_report(args.target, top=args.top,
+                                          flame=args.flame,
+                                          check=args.check)
+        except (OSError, ValueError) as e:
+            print("error: %s" % e, file=sys.stderr)
+            return 2
+        return 1 if (args.check and problems) else 0
 
     if args.cmd in ("history", "trend"):
         from .ledger import Ledger, default_ledger_dir

@@ -69,10 +69,18 @@ class PhaseClock:
     closes the iteration and returns ``(total_s, {phase: s})``.  Repeated
     laps with the same name within one iteration accumulate (the tree
     loop laps "grow" once per tree).
+
+    ``current`` is the name of the lap most recently crossed within the
+    in-flight iteration (None between iterations) — the phase tag the
+    sampling profiler (obs/prof.py) stamps on samples.  A plain
+    attribute written by the training thread and read racily by the
+    sampler: a torn read mis-tags one sample, which the window
+    aggregate does not care about.
     """
 
     def __init__(self, fence_laps=True):
         self.fence_laps = bool(fence_laps)
+        self.current = None         # last lap crossed, None between iters
         self._totals = {}           # phase -> cumulative seconds, all iters
         self._phases = {}           # phase -> seconds, current iteration
         self._t_begin = 0.0
@@ -80,6 +88,7 @@ class PhaseClock:
 
     def begin(self):
         self._phases = {}
+        self.current = None
         self._t_begin = self._t_last = time.perf_counter()
 
     def lap(self, name, value=None):
@@ -88,9 +97,11 @@ class PhaseClock:
         now = time.perf_counter()
         self._phases[name] = self._phases.get(name, 0.0) + (now - self._t_last)
         self._t_last = now
+        self.current = name
 
     def end(self, value=None):
         fence(value)
+        self.current = None
         now = time.perf_counter()
         total = now - self._t_begin
         # time since the last lap (or begin) that no lap() claimed

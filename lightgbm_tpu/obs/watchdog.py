@@ -40,23 +40,21 @@ from __future__ import annotations
 import json
 import os
 import signal
-import sys
 import threading
 import time
-import traceback
 
 from ..utils.log import Log
 
 
 def _thread_stacks():
-    """{thread label: [frame lines]} for every live Python thread."""
-    names = {t.ident: t.name for t in threading.enumerate()}
-    out = {}
-    for ident, frame in sys._current_frames().items():
-        label = "%s (%d)" % (names.get(ident, "?"), ident)
-        out[label] = [ln.rstrip("\n")
-                      for ln in traceback.format_stack(frame)]
-    return out
+    """{thread label: [frame lines]} for every live Python thread.
+
+    Delegates to the one shared ``sys._current_frames`` walker in
+    obs/prof.py — flight records, incident evidence and the sampling
+    profiler must agree on thread labeling, so there is exactly one
+    capture path."""
+    from .prof import capture_thread_stacks
+    return capture_thread_stacks()
 
 
 def dump_flight_record(obs, reason, label=None, extra=None):

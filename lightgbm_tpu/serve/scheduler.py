@@ -149,8 +149,11 @@ class MicrobatchScheduler:
         # a wedged runner's flight record must show what was stuck
         # behind it: queue depth, queued rows, pending route kinds
         self.observer.add_flight_provider(self._flight_state)
+        # lgbm- prefix: the host profiler (obs/prof.py), flight records
+        # and external tools all attribute thread samples by this name
         self._worker = threading.Thread(
-            target=self._loop, name="%s-microbatch" % name, daemon=True)
+            target=self._loop, name="lgbm-%s-microbatch" % name,
+            daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------- submit

@@ -154,6 +154,10 @@ ALIAS_TABLE: Dict[str, str] = {
     "obs_incidents": "obs_incident",
     "obs_incident_window": "obs_incident_window_s",
     "obs_incident_path": "obs_incident_dir",
+    "obs_profile_hz": "obs_prof_hz",
+    "obs_prof_rate": "obs_prof_hz",
+    "obs_prof_window": "obs_prof_window_s",
+    "obs_prof_top_k": "obs_prof_topk",
     "serve_microbatch_max": "serve_max_batch",
     "serve_deadline_ms": "serve_max_delay_ms",
     "serve_min_bucket": "serve_bucket_min",
@@ -244,6 +248,8 @@ PARAMETER_SET = {
     # incident engine (obs/incident.py)
     "obs_incident", "obs_incident_window_s", "obs_incident_dir",
     "obs_incident_trace",
+    # continuous host profiler (obs/prof.py)
+    "obs_prof_hz", "obs_prof_window_s", "obs_prof_topk",
     # serving tier (lightgbm_tpu/serve/)
     "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
     "serve_donate", "serve_batch_event_every",
@@ -773,6 +779,20 @@ class Config:
         # incident opens mid-training (PR-1 trace plumbing; never armed
         # on the serve hot path, which has no iteration to scope to)
         "obs_incident_trace": ("bool", False),
+        # continuous host sampling profiler (obs/prof.py): samples per
+        # second for the daemon-thread sys._current_frames walker that
+        # folds stacks into schema-16 `prof_profile` windows.  0 = off.
+        # Runs only when the observer is otherwise enabled — the default
+        # does NOT by itself turn the observer on.  29 is deliberately
+        # prime-ish so the jittered clock cannot alias with 10/50/100 Hz
+        # periodic work.
+        "obs_prof_hz": ("int", 29),
+        # window length: samples aggregate into one `prof_profile` event
+        # per window (top-K folded stacks + per-role/stage/phase totals)
+        "obs_prof_window_s": ("float", 5.0),
+        # folded stacks kept per window; the dropped tail is counted in
+        # the event's `truncated` field, never silently lost
+        "obs_prof_topk": ("int", 20),
         # serving tier (lightgbm_tpu/serve/, docs/Serving.md) — the
         # Booster.serve() microbatcher over AOT-compiled predict
         # executables.  Largest coalesced microbatch (and the largest

@@ -147,7 +147,8 @@ def test_unknown_route_404_and_index(tmp_path):
         assert code == 200
         assert set(json.loads(body)["endpoints"]) == {
             "/metrics", "/healthz", "/statusz", "/events", "/incidents",
-            "POST /trigger/flight", "POST /trigger/incident"}
+            "/prof?seconds=N", "POST /trigger/flight",
+            "POST /trigger/incident"}
     finally:
         obs.close()
 
@@ -384,3 +385,20 @@ def test_summary_finished_run_not_in_progress(tmp_path):
     m = timeline_metrics(load_timeline(str(path)))
     assert m.get("status") == "ok"
     assert "in_progress" not in m
+
+
+def test_prof_endpoint_returns_folded_burst(tmp_path):
+    obs = _live_obs(tmp_path)
+    try:
+        _run_a_bit(obs)
+        code, headers, body = _get(obs.live_url + "/prof?seconds=0.1")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert body.startswith("# samples=")
+        # unparseable seconds falls back to the default burst length
+        code, _, body = _get(obs.live_url + "/prof?seconds=bogus")
+        assert code == 200 and body.startswith("# samples=")
+        code, _, idx = _get(obs.live_url + "/")
+        assert "/prof" in idx
+    finally:
+        obs.close()

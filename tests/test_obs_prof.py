@@ -1,0 +1,456 @@
+"""Continuous host sampling profiler (lightgbm_tpu/obs/prof.py).
+
+Covers the ISSUE-20 contract: fake-clock window aggregation / folding /
+top-K truncation, the shared stack-capture path (sampler + watchdog
+flight records), the gated overhead budget, the wedged-sampler drill
+(injected exception -> loud ``error`` window -> ``obs prof --check``
+exits 1), burst captures with idle-thread filtering, the reader side
+(top table / flamegraph / check), and a concurrent serve-load test
+proving the profiler adds zero sheds and zero steady-state compiles."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import NULL_OBSERVER, RunObserver, read_events
+from lightgbm_tpu.obs.prof import (OVERHEAD_BUDGET_FRAC, HostProfiler,
+                                   _is_idle_stack, _short_path, _Window,
+                                   aggregate_window, burst,
+                                   capture_thread_stacks, check_profiles,
+                                   evidence_profile, fold_frames,
+                                   folded_text, merged_profile,
+                                   profile_events, render_flame,
+                                   render_top, thread_roles)
+from lightgbm_tpu.obs.query import main as query_main
+
+
+class FakeClock:
+    """Injectable monotonic clock: ticks cost zero unless advanced."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _collector():
+    payloads = []
+    return payloads, lambda ev, **fields: payloads.append(fields)
+
+
+# ------------------------------------------------------------------ folding
+def test_short_path_keeps_package_suffix():
+    sep = os.sep
+    p = sep.join(("", "x", "lightgbm_tpu", "obs", "prof.py"))
+    assert _short_path(p) == "lightgbm_tpu/obs/prof.py"
+    q = sep.join(("", "usr", "lib", "python3.11", "threading.py"))
+    assert _short_path(q) == "python3.11/threading.py"
+
+
+def test_fold_frames_root_to_leaf_order():
+    import sys
+    frame = sys._current_frames()[threading.get_ident()]
+    labels = fold_frames(frame)
+    assert labels, "live stack folds to at least one label"
+    # leaf (last label) is this very test function; the root is the
+    # interpreter / pytest entry, nowhere near the leaf
+    assert labels[-1].endswith(":test_fold_frames_root_to_leaf_order")
+    assert not labels[0].endswith(":test_fold_frames_root_to_leaf_order")
+    assert all(":" in lb for lb in labels)
+
+
+def test_idle_stack_filter():
+    assert _is_idle_stack([])                               # gone thread
+    assert _is_idle_stack(["python3.11/selectors.py:select"])
+    assert _is_idle_stack(["python3.11/threading.py:wait"])
+    # any lightgbm_tpu frame keeps the stack, whatever the leaf
+    assert not _is_idle_stack(["lightgbm_tpu/obs/events.py:run",
+                               "python3.11/threading.py:wait"])
+    # busy non-package work is kept too
+    assert not _is_idle_stack(["tests/test_obs_prof.py:spin"])
+
+
+def test_capture_thread_stacks_shape_and_watchdog_delegation():
+    me = threading.current_thread()
+    out = capture_thread_stacks()
+    label = "%s (%d)" % (me.name, me.ident)
+    assert label in out
+    assert isinstance(out[label], list)
+    assert any("capture_thread_stacks" in ln for ln in out[label])
+    # the watchdog's flight-record capture is the SAME path (one
+    # sys._current_frames walker in tree) — same keys, same shape
+    from lightgbm_tpu.obs.watchdog import _thread_stacks
+    out2 = _thread_stacks()
+    assert label in out2
+    assert isinstance(out2[label], list)
+    assert thread_roles()[me.ident] == me.name
+
+
+# ----------------------------------------------------- fake-clock windowing
+def test_window_aggregation_with_fake_clock():
+    clk = FakeClock(100.0)
+    payloads, emit = _collector()
+    iters = iter([3, 4, 7])
+    prof = HostProfiler(emit=emit, hz=10, window_s=5.0, topk=0,
+                        context={"stage": "boost"},
+                        phase_of=lambda: "grow",
+                        iter_of=lambda: next(iters), clock=clk)
+    for _ in range(3):
+        prof.tick()
+    clk.t = 102.0
+    payload = prof.flush_now()
+    assert payloads[-1] is payload or payloads[-1] == payload
+    assert payload["samples"] == 3
+    assert payload["dur_s"] == pytest.approx(2.0)
+    assert payload["hz"] == 10
+    assert payload["cost_s"] == 0.0         # fake clock never advanced
+    assert payload["overhead_frac"] == 0.0
+    assert payload["stages"] == {"boost": 3}
+    assert payload["phases"] == {"grow": 3}
+    assert payload["iter_lo"] == 3 and payload["iter_hi"] == 7
+    # the ticking (main) thread is sampled through prof.tick itself, so
+    # every stack key carries its role and a lightgbm_tpu frame
+    assert payload["stacks"]
+    assert all(k.split(";", 1)[0] == "MainThread"
+               for k in payload["stacks"])
+    assert all("lightgbm_tpu/obs/prof.py:tick" in k
+               for k in payload["stacks"])
+    # flush swapped in a fresh window
+    assert prof.peek()["samples"] == 0
+
+
+def test_topk_truncation_deterministic():
+    w = _Window(0.0)
+    w.samples = 9
+    w.stacks = {"r;a:f": 5, "r;b:g": 3, "r;c:h": 1}
+    p = aggregate_window(w, 1.0, 29, topk=2)
+    assert p["stacks"] == {"r;a:f": 5, "r;b:g": 3}
+    assert p["truncated"] == 1 and p["topk"] == 2
+    # count ties break on the stack name (deterministic order)
+    w2 = _Window(0.0)
+    w2.stacks = {"r;z": 2, "r;a": 2, "r;m": 2}
+    p2 = aggregate_window(w2, 1.0, 29, topk=2)
+    assert list(p2["stacks"]) == ["r;a", "r;m"]
+    # topk <= 0 keeps everything (burst captures)
+    assert aggregate_window(w, 1.0, 29, topk=0)["truncated"] == 0
+
+
+def test_overhead_frac_self_measured():
+    class CostClock:
+        """Every call advances 1ms — each tick 'costs' exactly 1ms."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 0.001
+            return self.t
+
+    payloads, emit = _collector()
+    prof = HostProfiler(emit=emit, hz=10, window_s=5.0, clock=CostClock())
+    for _ in range(5):
+        prof.tick()
+    payload = prof.flush_now(now=1.0)
+    assert payload["cost_s"] == pytest.approx(0.005, abs=1e-6)
+    assert payload["overhead_frac"] == pytest.approx(
+        payload["cost_s"] / payload["dur_s"], abs=1e-4)
+
+
+# ------------------------------------------------------------ check gate
+def _prof_ev(**kw):
+    base = {"ev": "prof_profile", "samples": 10, "dur_s": 1.0, "hz": 29,
+            "cost_s": 0.001, "overhead_frac": 0.001}
+    base.update(kw)
+    return base
+
+
+def test_check_profiles_rules():
+    iters = [{"ev": "iter", "it": i} for i in range(2)]
+    assert check_profiles(iters + [_prof_ev()]) == []
+    # no prof events at all: the profiler may be off — pass
+    assert check_profiles(iters) == []
+    # sampler error window is loud
+    probs = check_profiles(iters + [_prof_ev(error="RuntimeError('x')")])
+    assert any("sampler error" in p for p in probs)
+    # blown overhead budget
+    probs = check_profiles(
+        iters + [_prof_ev(cost_s=0.5, overhead_frac=0.5)])
+    assert any("budget" in p for p in probs)
+    assert OVERHEAD_BUDGET_FRAC == 0.01
+    # zero samples while iterations advanced = wedged sampler
+    probs = check_profiles(iters + [_prof_ev(samples=0)])
+    assert any("zero samples" in p for p in probs)
+    # ... but zero samples with no training loop is fine (serve-only)
+    assert check_profiles([_prof_ev(samples=0)]) == []
+
+
+# ------------------------------------------------------- wedged sampler
+def test_wedged_sampler_is_loud_and_stops():
+    payloads, emit = _collector()
+
+    def boom():
+        raise RuntimeError("frames exploded")
+
+    prof = HostProfiler(emit=emit, hz=200, window_s=60.0, frames_fn=boom)
+    prof.start()
+    deadline = time.monotonic() + 5.0
+    while not prof.wedged and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert prof.wedged
+    prof._thread.join(timeout=2.0)
+    assert not prof.running              # sampling stopped, not spinning
+    assert len(payloads) == 1            # exactly one poisoned window
+    assert "frames exploded" in payloads[0]["error"]
+    evs = [dict(payloads[0], ev="prof_profile")]
+    assert any("sampler error" in p for p in check_profiles(evs))
+    prof.stop()                          # idempotent, no second flush
+    assert len(payloads) == 1
+
+
+def test_cli_check_exits_1_on_wedged_timeline(tmp_path, capsys):
+    path = str(tmp_path / "wedged" / "t.jsonl")
+    os.makedirs(os.path.dirname(path))
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    for i in range(2):
+        obs.event("iter", it=i, time_s=0.01, phases={}, fenced=False)
+    obs.event("prof_profile", samples=0, dur_s=1.0, hz=29, cost_s=0.0,
+              error="RuntimeError('boom')", source="train")
+    obs.close()
+    assert query_main(["prof", path, "--check"]) == 1
+    text = capsys.readouterr().out
+    assert "PROF CHECK" in text and "sampler error" in text
+    # without --check the report prints but the exit stays 0
+    assert query_main(["prof", path]) == 0
+
+
+def test_cli_check_exits_0_on_clean_timeline(tmp_path, capsys):
+    path = str(tmp_path / "clean.jsonl")
+    obs = RunObserver(events_path=path)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    for i in range(2):
+        obs.event("iter", it=i, time_s=0.01, phases={}, fenced=False)
+    obs.event("prof_profile", samples=5, dur_s=1.0, hz=29, cost_s=0.001,
+              stacks={"MainThread;lightgbm_tpu/x.py:f": 5},
+              roles={"MainThread": 5}, source="train")
+    obs.close()
+    # a directory target resolves to its newest *.jsonl
+    flame = str(tmp_path / "f.html")
+    assert query_main(["prof", str(tmp_path), "--check",
+                       "--flame", flame, "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "PROF CHECK: ok" in out and "host profile:" in out
+    assert os.path.exists(flame)
+    with open(flame) as f:
+        html = f.read()
+    assert "host sampling profile" in html and "lightgbm_tpu/x.py:f" in html
+
+
+def test_cli_errors_exit_2(tmp_path, capsys):
+    assert query_main(["prof", str(tmp_path / "missing.jsonl"),
+                       "--check"]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert query_main(["prof", str(empty)]) == 2    # no .jsonl inside
+    capsys.readouterr()
+
+
+# ------------------------------------------------------- burst / evidence
+def test_burst_samples_other_threads_not_self():
+    stop = threading.Event()
+
+    def spin():
+        x = 0
+        while not stop.is_set():
+            x += 1
+
+    t = threading.Thread(target=spin, name="lgbm-test-busy", daemon=True)
+    t.start()
+    try:
+        payload = burst(seconds=0.15, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    assert payload["samples"] > 0
+    assert payload["source"] == "burst"
+    roles = {k.split(";", 1)[0] for k in payload["stacks"]}
+    assert "lgbm-test-busy" in roles         # busy thread sampled
+    assert "MainThread" not in roles         # the capturing thread is not
+    text = folded_text(payload)
+    assert text.startswith("# samples=")
+    assert "lgbm-test-busy;" in text
+
+
+def test_evidence_profile_prefers_live_window_else_bursts():
+    class Obs:
+        _run_context = {"stage": "boost"}
+
+    payload = evidence_profile(Obs(), seconds=0.05)   # no armed profiler
+    assert payload["source"] == "incident"
+
+    payloads, emit = _collector()
+    live = HostProfiler(emit=emit, hz=50, window_s=60.0)
+    live.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while live.peek()["samples"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        armed = Obs()
+        armed._prof = live
+        snap = evidence_profile(armed)
+    finally:
+        live.stop()
+    assert snap["source"] == "train" and snap["samples"] > 0
+    assert payloads == [] or snap["samples"] >= 0    # peek never flushes
+
+
+# --------------------------------------------------------- observer wiring
+def test_run_observer_arm_disarm_and_null_paths(tmp_path):
+    assert NULL_OBSERVER.prof_arm() is None
+    NULL_OBSERVER.prof_disarm()                      # no-op, no raise
+
+    off = RunObserver(events_path=str(tmp_path / "off.jsonl"), prof_hz=0)
+    off.run_header(backend="cpu", devices=[], params={}, context={})
+    assert off.prof_arm() is None                    # hz=0 keeps it off
+    off.close()
+    assert profile_events(read_events(str(tmp_path / "off.jsonl"))) == []
+
+    path = str(tmp_path / "on.jsonl")
+    obs = RunObserver(events_path=path, prof_hz=100, prof_window_s=60.0)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    prof = obs.prof_arm()
+    assert prof is not None and prof.running
+    assert obs.prof_arm() is prof                    # idempotent
+    assert "lgbm-obs-prof" in {t.name for t in threading.enumerate()}
+    deadline = time.monotonic() + 5.0
+    while prof.peek()["samples"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    obs.close()                                      # disarms + final flush
+    assert not prof.running
+    profs = profile_events(read_events(path))
+    assert profs and profs[-1]["samples"] > 0
+
+
+def test_phase_clock_current_transitions():
+    from lightgbm_tpu.obs.timers import PhaseClock
+    pc = PhaseClock(fence_laps=False)
+    assert pc.current is None
+    pc.begin()
+    assert pc.current is None
+    pc.lap("grow")
+    assert pc.current == "grow"
+    pc.lap("update")
+    assert pc.current == "update"
+    pc.end()
+    assert pc.current is None
+
+
+# --------------------------------------------------------- end-to-end runs
+def test_training_run_emits_schema_valid_profiles(tmp_path):
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2000, 10))
+    y = X @ rng.normal(size=10) + 0.1 * rng.normal(size=2000)
+    path = str(tmp_path / "train.jsonl")
+    params = {"objective": "regression", "verbose": -1, "num_leaves": 31,
+              "obs_events_path": path, "obs_timing": "iter",
+              "obs_prof_hz": 29, "obs_prof_window_s": 0.5}
+    lgb.train(params, lgb.Dataset(X, label=y, params=params),
+              num_boost_round=30)
+    evs = read_events(path)                 # schema-validates everything
+    profs = profile_events(evs)
+    assert profs, "a training run lands >= 1 prof_profile window"
+    m = merged_profile(profs)
+    assert m["samples"] > 0
+    top_stack = max(m["stacks"].items(), key=lambda kv: (kv[1], kv[0]))[0]
+    assert "lightgbm_tpu/" in top_stack
+    assert m["overhead_frac"] < OVERHEAD_BUDGET_FRAC
+    assert check_profiles(evs) == []
+    assert "MainThread" in m["roles"]
+    # every sample was stage-tagged from the live run context
+    assert sum(m["stages"].values()) == m["samples"]
+    # the ledger gates the same overhead number as a recorded cell
+    from lightgbm_tpu.obs.ledger import metrics_from_events
+    frac = metrics_from_events(evs).get("prof_overhead_frac")
+    assert frac is not None and frac < OVERHEAD_BUDGET_FRAC
+    # reader side renders over the real run
+    import io
+    buf = io.StringIO()
+    rollup = render_top(evs, top=5, out=buf)
+    assert rollup["samples"] == m["samples"]
+    assert "host profile:" in buf.getvalue()
+    assert render_flame(evs, str(tmp_path / "flame.html")) > 0
+    # the sampler thread died with the run
+    assert "lgbm-obs-prof" not in {t.name for t in threading.enumerate()}
+
+
+def test_profiler_off_by_obs_prof_hz_zero(tmp_path):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 5))
+    y = X @ rng.normal(size=5)
+    path = str(tmp_path / "off.jsonl")
+    params = {"objective": "regression", "verbose": -1,
+              "obs_events_path": path, "obs_prof_hz": 0}
+    lgb.train(params, lgb.Dataset(X, label=y, params=params),
+              num_boost_round=3)
+    evs = read_events(path)
+    assert profile_events(evs) == []
+    assert check_profiles(evs) == []        # off is a pass, not a wedge
+
+
+def test_serve_load_zero_sheds_zero_steady_compiles(tmp_path):
+    from lightgbm_tpu.serve import ServingPredictor
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(600, 8))
+    w = rng.normal(size=8)
+    y = (X @ w > 0).astype(np.float64)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+         "min_data_in_leaf": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=10)
+    path = str(tmp_path / "serve.jsonl")
+    obs = RunObserver(events_path=path, prof_hz=97, prof_window_s=0.5)
+    obs.run_header(backend="cpu", devices=[], params={}, context={})
+    obs.prof_arm()
+    errs = []
+    with ServingPredictor(bst._gbdt, max_delay_ms=1.0, observer=obs,
+                          queue_limit=256) as sp:
+        # warm every bucket coalesced load can land on: 4 submitters x
+        # 40 rows microbatch into up to 160-row batches
+        sp.cache.warmup(sizes=[64, 128, 256])
+        sp.cache.mark_warm()
+        warm_compiles = sp.cache.compiles
+        stop = threading.Event()
+
+        def pound():
+            try:
+                while not stop.is_set():
+                    sp.predict(X[:40])
+            except Exception as e:          # pragma: no cover - fail loud
+                errs.append(e)
+
+        threads = [threading.Thread(target=pound,
+                                    name="lgbm-test-load-%d" % i)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert errs == []
+        # the profiler rode along: no new compiles, nothing shed
+        assert sp.cache.compiles == warm_compiles
+        assert sp.scheduler.stats()["shed_total"] == 0
+    obs.close()
+    evs = read_events(path)
+    profs = profile_events(evs)
+    assert profs
+    m = merged_profile(profs)
+    assert m["samples"] > 0
+    assert not m["errors"]
+    # role attribution: the serve worker carries its stable thread name
+    assert any("microbatch" in role for role in m["roles"])

@@ -310,6 +310,18 @@ NOTES = {
                           "window when an incident opens mid-training "
                           "(never on the serve hot path); the trace "
                           "lands in the evidence bundle",
+    "obs_prof_hz": "continuous host sampling profiler (obs/prof.py): "
+                   "samples per second for the daemon thread that "
+                   "folds every thread's stack into schema-16 "
+                   "`prof_profile` windows (0 = off; ~29 default, "
+                   "prime-ish to avoid aliasing).  Piggybacks on an "
+                   "otherwise-enabled observer — never turns the "
+                   "observer on by itself; self-measured overhead "
+                   "gated <1% by `obs prof --check`",
+    "obs_prof_window_s": "profiler window length: samples aggregate "
+                         "into one `prof_profile` event per window",
+    "obs_prof_topk": "folded stacks kept per window; the dropped tail "
+                     "is counted in the event's `truncated` field",
     "ooc_chunk_rows": "out-of-core streaming ingest: rows per chunk "
                       "(the host-memory budget unit; text chunks size "
                       "to it via a bytes-per-row estimate) — see "
@@ -398,7 +410,8 @@ GROUPS = [
         "obs_drift_fingerprint", "obs_drift_topk",
         "obs_drift_min_labels", "obs_incident",
         "obs_incident_window_s", "obs_incident_dir",
-        "obs_incident_trace"]),
+        "obs_incident_trace", "obs_prof_hz", "obs_prof_window_s",
+        "obs_prof_topk"]),
     ("Serving", [
         "serve_max_batch", "serve_max_delay_ms", "serve_bucket_min",
         "serve_donate", "serve_batch_event_every", "serve_queue_limit",

@@ -40,12 +40,27 @@ def _print_roofline(gbdt, outdir):
         from lightgbm_tpu.obs import read_events
         from lightgbm_tpu.obs.roofline import render_roofline
         print()
-        render_roofline(read_events(obs_path))
+        events = read_events(obs_path)
+        render_roofline(events)
         print("timeline written to", obs_path,
               "- rerun the table with: python -m lightgbm_tpu obs "
               "roofline", obs_path)
     except Exception as e:           # the trace must survive a table bug
         print("tpu_profile: roofline table unavailable (%s)" % e,
+              file=sys.stderr)
+        return
+    # the host half of the same window (obs/prof.py): the device trace
+    # above shows what the chips ran, this shows what the host was doing
+    # between submissions — one command, both halves of the pipeline
+    try:
+        from lightgbm_tpu.obs.prof import render_top
+        print()
+        render_top(events, top=10)
+        print("full host profile: python -m lightgbm_tpu obs prof %s "
+              "--flame %s" % (obs_path,
+                              os.path.join(outdir, "flamegraph.html")))
+    except Exception as e:
+        print("tpu_profile: host top-table unavailable (%s)" % e,
               file=sys.stderr)
 
 
